@@ -1,0 +1,27 @@
+"""chunky-bits-tpu: a TPU-native distributed erasure-coded object store.
+
+A brand-new framework with the capabilities of MilesBreslin/Chunky-Bits
+(reference: /root/reference, Rust): files are split into parts of ``d`` data +
+``p`` parity chunks (Reed-Solomon over GF(2^8)), content-addressed by SHA-256
+and scattered over weighted, zone-tagged destinations (local disks or dumb
+HTTP endpoints), with a small YAML/JSON file reference as the only metadata.
+
+The compute plane differs from the reference: the Reed-Solomon encode/decode
+hot path (reference: src/file/file_part.rs:161,128,302) runs as batched
+GF(2^8) bit-plane matmuls on TPU via JAX/XLA/Pallas, behind a pluggable
+``ErasureBackend``.  A native C++ CPU backend with the identical matrix
+convention is the correctness oracle.
+"""
+
+__version__ = "0.1.0"
+
+from chunky_bits_tpu.errors import (  # noqa: F401
+    ChunkyBitsError,
+    ClusterError,
+    FileReadError,
+    FileWriteError,
+    LocationError,
+    LocationParseError,
+    MetadataReadError,
+    ShardError,
+)

@@ -1,0 +1,149 @@
+// Native GF(2^8) erasure codec — the CPU oracle backend.
+//
+// Same role as the reference's `reed-solomon-erasure` crate (CPU SIMD GF(2^8)
+// tables; reference: Cargo.toml:21, used at src/file/file_part.rs:161,302):
+// applies a GF(2^8) matrix to a batch of stacked shards.  Field is 0x11d with
+// generator 2, identical to chunky_bits_tpu/ops/gf256.py — the Python side
+// cross-checks the tables at load time.
+//
+// The inner loop uses the classic nibble-table pshufb trick under AVX2
+// (c*x = T_c[x>>4 << 4] ^ T_c[x&15]) and falls back to full-table scalar
+// lookups elsewhere.  Batch items are fanned across std::threads.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#ifdef __AVX2__
+#include <immintrin.h>
+#endif
+
+namespace {
+
+uint8_t MUL[256][256];
+
+bool init_tables() {
+    uint8_t exp_t[512];
+    int log_t[256] = {0};
+    int x = 1;
+    for (int i = 0; i < 255; i++) {
+        exp_t[i] = static_cast<uint8_t>(x);
+        log_t[x] = i;
+        x <<= 1;
+        if (x & 0x100) x ^= 0x11d;
+    }
+    for (int i = 255; i < 512; i++) exp_t[i] = exp_t[i - 255];
+    for (int a = 0; a < 256; a++) {
+        for (int b = 0; b < 256; b++) {
+            MUL[a][b] = (a && b)
+                ? exp_t[(log_t[a] + log_t[b]) % 255]
+                : 0;
+        }
+    }
+    return true;
+}
+
+const bool kInited = init_tables();
+
+void xor_row(const uint8_t* src, uint8_t* dst, size_t n) {
+    size_t i = 0;
+#ifdef __AVX2__
+    for (; i + 32 <= n; i += 32) {
+        __m256i v = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i*>(src + i));
+        __m256i d = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i*>(dst + i));
+        _mm256_storeu_si256(reinterpret_cast<__m256i*>(dst + i),
+                            _mm256_xor_si256(d, v));
+    }
+#endif
+    for (; i < n; i++) dst[i] ^= src[i];
+}
+
+void mul_row_xor(uint8_t c, const uint8_t* src, uint8_t* dst, size_t n) {
+    const uint8_t* table = MUL[c];
+    size_t i = 0;
+#ifdef __AVX2__
+    alignas(16) uint8_t lo[16], hi[16];
+    for (int v = 0; v < 16; v++) {
+        lo[v] = MUL[c][v];
+        hi[v] = MUL[c][v << 4];
+    }
+    __m256i vlo = _mm256_broadcastsi128_si256(
+        _mm_load_si128(reinterpret_cast<const __m128i*>(lo)));
+    __m256i vhi = _mm256_broadcastsi128_si256(
+        _mm_load_si128(reinterpret_cast<const __m128i*>(hi)));
+    __m256i mask = _mm256_set1_epi8(0x0f);
+    for (; i + 32 <= n; i += 32) {
+        __m256i v = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i*>(src + i));
+        __m256i l = _mm256_and_si256(v, mask);
+        __m256i h = _mm256_and_si256(_mm256_srli_epi64(v, 4), mask);
+        __m256i r = _mm256_xor_si256(_mm256_shuffle_epi8(vlo, l),
+                                     _mm256_shuffle_epi8(vhi, h));
+        __m256i d = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i*>(dst + i));
+        _mm256_storeu_si256(reinterpret_cast<__m256i*>(dst + i),
+                            _mm256_xor_si256(d, r));
+    }
+#endif
+    for (; i < n; i++) dst[i] ^= table[src[i]];
+}
+
+// One batch item: out[r, s] = mat[r, k] (x) shards[k, s] over GF(2^8).
+void apply_one(const uint8_t* mat, size_t r, size_t k,
+               const uint8_t* shards, size_t s, uint8_t* out) {
+    std::memset(out, 0, r * s);
+    for (size_t i = 0; i < r; i++) {
+        uint8_t* dst = out + i * s;
+        for (size_t j = 0; j < k; j++) {
+            uint8_t c = mat[i * k + j];
+            if (c == 0) continue;
+            const uint8_t* src = shards + j * s;
+            if (c == 1) {
+                xor_row(src, dst, s);
+            } else {
+                mul_row_xor(c, src, dst, s);
+            }
+        }
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// out[b, r, s] = mat[r, k] (x) shards[b, k, s]; nthreads <= 0 => hardware.
+void cb_apply_matrix(const uint8_t* mat, size_t r, size_t k,
+                     const uint8_t* shards, size_t b, size_t s,
+                     uint8_t* out, int nthreads) {
+    if (!kInited || r == 0 || b == 0 || s == 0) return;
+    size_t want = nthreads > 0
+        ? static_cast<size_t>(nthreads)
+        : static_cast<size_t>(std::thread::hardware_concurrency());
+    if (want == 0) want = 1;
+    size_t threads = want < b ? want : b;
+    if (threads <= 1) {
+        for (size_t i = 0; i < b; i++) {
+            apply_one(mat, r, k, shards + i * k * s, s, out + i * r * s);
+        }
+        return;
+    }
+    std::vector<std::thread> pool;
+    pool.reserve(threads);
+    for (size_t t = 0; t < threads; t++) {
+        pool.emplace_back([=]() {
+            for (size_t i = t; i < b; i += threads) {
+                apply_one(mat, r, k, shards + i * k * s, s, out + i * r * s);
+            }
+        });
+    }
+    for (auto& th : pool) th.join();
+}
+
+// Table self-check hook: lets Python assert C++ and numpy agree on the field.
+uint8_t cb_gf_mul(uint8_t a, uint8_t b) { return MUL[a][b]; }
+
+}  // extern "C"

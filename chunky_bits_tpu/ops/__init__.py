@@ -1,0 +1,9 @@
+"""Compute plane: GF(2^8) math and the pluggable erasure backends."""
+
+from chunky_bits_tpu.ops.backend import (  # noqa: F401
+    ErasureBackend,
+    ErasureCoder,
+    get_backend,
+    get_coder,
+    register_backend,
+)

@@ -1,0 +1,127 @@
+"""KATs and algebraic checks for the GF(2^8) core and the RS matrix
+convention (mirrors the role of the reference crate's own field tests;
+the geometry grid mirrors tests/file.rs:26-56)."""
+
+import numpy as np
+import pytest
+
+from chunky_bits_tpu.errors import ErasureError
+from chunky_bits_tpu.ops import gf256, matrix
+from chunky_bits_tpu.ops.backend import ErasureCoder, NumpyBackend
+
+
+def test_field_known_values():
+    # Known values of the 0x11d / generator-2 field (same field as the
+    # reference's galois_8 and the Linux RAID6 tables).
+    assert gf256.EXP_TABLE[0] == 1
+    assert gf256.EXP_TABLE[1] == 2
+    assert gf256.EXP_TABLE[8] == 29  # 2^8 = 0x100 ^ 0x11d = 29
+    assert gf256.LOG_TABLE[3] == 25
+    assert gf256.gf_mul(0x80, 2) == 29
+    assert gf256.gf_mul(0, 123) == 0
+    assert gf256.gf_mul(1, 123) == 123
+
+
+def test_field_axioms_sampled():
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+        assert gf256.gf_mul(a, b) == gf256.gf_mul(b, a)
+        assert gf256.gf_mul(a, gf256.gf_mul(b, c)) == gf256.gf_mul(
+            gf256.gf_mul(a, b), c
+        )
+        # distributive over XOR (field addition)
+        assert gf256.gf_mul(a, b ^ c) == gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+        if b:
+            assert gf256.gf_mul(gf256.gf_div(a, b), b) == a
+    for a in range(1, 256):
+        assert gf256.gf_mul(a, gf256.gf_inv(a)) == 1
+
+
+def test_mul_bit_matrix_matches_scalar():
+    rng = np.random.default_rng(1)
+    for c in [0, 1, 2, 3, 29, 128, 255]:
+        m = gf256.mul_bit_matrix(c)
+        for x in rng.integers(0, 256, 16):
+            x = int(x)
+            bits = np.array([(x >> k) & 1 for k in range(8)], dtype=np.uint8)
+            out_bits = (m @ bits) % 2
+            out = sum(int(v) << k for k, v in enumerate(out_bits))
+            assert out == gf256.gf_mul(c, x)
+
+
+def test_invert_roundtrip():
+    rng = np.random.default_rng(2)
+    for n in (1, 2, 5, 10):
+        while True:
+            m = rng.integers(0, 256, (n, n)).astype(np.uint8)
+            try:
+                inv = matrix.gf_invert(m)
+                break
+            except ErasureError:
+                continue
+        assert np.array_equal(matrix.gf_matmul(m, inv), matrix.gf_identity(n))
+
+
+def test_encode_matrix_convention():
+    # Hand-derived for d=2, p=1: V rows [1,0],[1,1],[1,2]; top is
+    # self-inverse; parity row = [1^2, 2] = [3, 2].
+    e = matrix.build_encode_matrix(2, 1)
+    assert e.tolist() == [[1, 0], [0, 1], [3, 2]]
+    # d=1: every parity row is [1] => parity shards replicate the data shard.
+    e1 = matrix.build_encode_matrix(1, 3)
+    assert e1.tolist() == [[1], [1], [1], [1]]
+    # Systematic top for a larger geometry.
+    e2 = matrix.build_encode_matrix(10, 4)
+    assert np.array_equal(e2[:10], matrix.gf_identity(10))
+
+
+@pytest.mark.parametrize("d", [1, 2, 3])
+@pytest.mark.parametrize("p", [0, 1, 2, 3])
+def test_encode_reconstruct_grid(d, p):
+    rng = np.random.default_rng(d * 10 + p)
+    size = 257
+    coder = ErasureCoder(d, p, NumpyBackend())
+    data = rng.integers(0, 256, (4, d, size)).astype(np.uint8)
+    parity = coder.encode_batch(data)
+    assert parity.shape == (4, p, size)
+    full = np.concatenate([data, parity], axis=1)
+    if p == 0:
+        return
+    # Erase up to p shards, reconstruct, compare byte-for-byte.
+    for erased_count in range(1, p + 1):
+        erased = list(
+            rng.choice(d + p, size=erased_count, replace=False).astype(int)
+        )
+        shards = [None if i in erased else full[0, i].copy()
+                  for i in range(d + p)]
+        out = coder.reconstruct(shards)
+        for i in range(d + p):
+            assert np.array_equal(out[i], full[0, i]), (i, erased)
+
+
+def test_reconstruct_data_only():
+    coder = ErasureCoder(3, 2, NumpyBackend())
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, (1, 3, 64)).astype(np.uint8)
+    parity = coder.encode_batch(data)
+    full = np.concatenate([data, parity], axis=1)[0]
+    shards = [None, full[1].copy(), None, full[3].copy(), full[4].copy()]
+    out = coder.reconstruct_data(shards)
+    assert np.array_equal(out[0], full[0])
+    assert np.array_equal(out[2], full[2])
+
+
+def test_too_few_shards():
+    coder = ErasureCoder(3, 2, NumpyBackend())
+    shards = [np.zeros(8, dtype=np.uint8), None, None, None,
+              np.zeros(8, dtype=np.uint8)]
+    with pytest.raises(ErasureError):
+        coder.reconstruct(shards)
+
+
+def test_bad_geometry():
+    with pytest.raises(ErasureError):
+        ErasureCoder(0, 2, NumpyBackend())
+    with pytest.raises(ErasureError):
+        matrix.build_encode_matrix(200, 200)
