@@ -1,0 +1,21 @@
+"""Cluster orchestration (the reference's src/cluster/ layer)."""
+
+from chunky_bits_tpu.cluster.cluster import Cluster  # noqa: F401
+from chunky_bits_tpu.cluster.destination import (  # noqa: F401
+    ClusterWriter,
+    Destination,
+)
+from chunky_bits_tpu.cluster.metadata import (  # noqa: F401
+    FileOrDirectory,
+    MetadataFormat,
+    MetadataGit,
+    MetadataPath,
+    metadata_from_obj,
+)
+from chunky_bits_tpu.cluster.nodes import ClusterNode, ClusterNodes  # noqa: F401
+from chunky_bits_tpu.cluster.profile import (  # noqa: F401
+    ClusterProfile,
+    ClusterProfiles,
+    ZoneRule,
+)
+from chunky_bits_tpu.cluster.tunables import Tunables  # noqa: F401

@@ -1,0 +1,163 @@
+"""The cluster façade: config-deserialized top level of the system.
+
+Mirrors src/cluster/cluster.rs: ``{destinations, metadata, profiles,
+tunables}`` with serde aliases (``nodes``/``node``/``destination``,
+``tunable``/``tuning``; cluster.rs:43-56).  Builds write pipelines over the
+placement engine, reads files back through the part codec, lists metadata.
+
+The reference's ``get_file_writer`` forgets to set ``parity_chunks``
+(cluster.rs:65-71 — profile parity is silently replaced by the library
+default of 2); that bug is fixed here, matching the behavior of its own
+``write_file_with_report`` (cluster.rs:109-113), per SURVEY §7.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from chunky_bits_tpu.cluster.destination import Destination
+from chunky_bits_tpu.cluster.metadata import (
+    FileOrDirectory,
+    MetadataFormat,
+    MetadataStore,
+    metadata_from_obj,
+)
+from chunky_bits_tpu.cluster.nodes import ClusterNodes
+from chunky_bits_tpu.cluster.profile import ClusterProfile, ClusterProfiles
+from chunky_bits_tpu.cluster.tunables import Tunables
+from chunky_bits_tpu.errors import SerdeError
+from chunky_bits_tpu.file.file_reference import FileReference
+from chunky_bits_tpu.file.location import Location
+from chunky_bits_tpu.file.profiler import ProfileReport, new_profiler
+from chunky_bits_tpu.file.writer import FileWriteBuilder
+from chunky_bits_tpu.utils import aio
+
+
+class Cluster:
+    def __init__(self, destinations: ClusterNodes,
+                 metadata: MetadataStore,
+                 profiles: ClusterProfiles,
+                 tunables: Optional[Tunables] = None):
+        self.destinations = destinations
+        self.metadata = metadata
+        self.profiles = profiles
+        self.tunables = tunables or Tunables()
+
+    # ---- serde ----
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "Cluster":
+        if not isinstance(obj, dict):
+            raise SerdeError("cluster config must be a mapping")
+        nodes_obj = None
+        for key in ("destinations", "destination", "nodes", "node"):
+            if key in obj:
+                nodes_obj = obj[key]
+                break
+        if nodes_obj is None:
+            raise SerdeError("cluster config missing destinations")
+        meta_obj = obj.get("metadata")
+        if meta_obj is None:
+            raise SerdeError("cluster config missing metadata")
+        if "profiles" not in obj:
+            raise SerdeError("cluster config missing profiles")
+        tunables_obj = None
+        for key in ("tunables", "tunable", "tuning"):
+            if key in obj:
+                tunables_obj = obj[key]
+                break
+        return cls(
+            destinations=ClusterNodes.from_obj(nodes_obj),
+            metadata=metadata_from_obj(meta_obj),
+            profiles=ClusterProfiles.from_obj(obj["profiles"]),
+            tunables=Tunables.from_obj(tunables_obj),
+        )
+
+    def to_obj(self) -> dict:
+        return {
+            "destinations": self.destinations.to_obj(),
+            "metadata": self.metadata.to_obj(),
+            "profiles": self.profiles.to_obj(),
+            "tunables": self.tunables.to_obj(),
+        }
+
+    @classmethod
+    async def from_location(cls, location: Union[str, Location],
+                            ) -> "Cluster":
+        """Load cluster.yaml from any Location (cluster.rs:59-63)."""
+        obj = await MetadataFormat("yaml").from_location(location)
+        return cls.from_obj(obj)
+
+    # ---- profiles ----
+
+    def get_profile(self, name: Optional[str] = None
+                    ) -> Optional[ClusterProfile]:
+        return self.profiles.get(name)
+
+    # ---- write path ----
+
+    def get_destination(self, profile: ClusterProfile) -> Destination:
+        return Destination(
+            self.destinations, profile, self.tunables.location_context())
+
+    def get_destination_with_profiler(
+        self, profile: ClusterProfile
+    ) -> tuple[object, Destination]:
+        profiler, reporter = new_profiler()
+        cx = self.tunables.location_context().but_with(profiler=profiler)
+        return reporter, Destination(self.destinations, profile, cx)
+
+    def get_file_writer(self, profile: ClusterProfile) -> FileWriteBuilder:
+        return (
+            FileWriteBuilder()
+            .with_destination(self.get_destination(profile))
+            .with_chunk_size(profile.get_chunk_size())
+            .with_data_chunks(profile.get_data_chunks())
+            # deliberate fix of the reference's missing parity setter
+            .with_parity_chunks(profile.get_parity_chunks())
+            .with_backend(self.tunables.backend)
+        )
+
+    async def write_file_ref(self, path: str,
+                             file_ref: FileReference) -> None:
+        await self.metadata.write(path, file_ref.to_obj())
+
+    async def write_file(self, path: str, reader: aio.AsyncByteReader,
+                         profile: ClusterProfile,
+                         content_type: Optional[str] = None) -> FileReference:
+        file_ref = await self.get_file_writer(profile).write(reader)
+        file_ref.content_type = content_type
+        await self.write_file_ref(path, file_ref)
+        return file_ref
+
+    async def write_file_with_report(
+        self, path: str, reader: aio.AsyncByteReader,
+        profile: ClusterProfile, content_type: Optional[str] = None,
+    ) -> tuple[ProfileReport, FileReference]:
+        reporter, destination = self.get_destination_with_profiler(profile)
+        file_ref = await (
+            FileWriteBuilder()
+            .with_destination(destination)
+            .with_chunk_size(profile.get_chunk_size())
+            .with_data_chunks(profile.get_data_chunks())
+            .with_parity_chunks(profile.get_parity_chunks())
+            .with_backend(self.tunables.backend)
+            .write(reader)
+        )
+        file_ref.content_type = content_type
+        await self.write_file_ref(path, file_ref)
+        return reporter.profile(), file_ref
+
+    # ---- read path ----
+
+    async def get_file_ref(self, path: str) -> FileReference:
+        obj = await self.metadata.read(path)
+        return FileReference.from_obj(obj)
+
+    async def read_file(self, path: str) -> aio.AsyncByteReader:
+        file_ref = await self.get_file_ref(path)
+        return file_ref.read_builder(
+            self.tunables.location_context()).reader()
+
+    async def list_files(self, path: str = ".") -> list[FileOrDirectory]:
+        return await self.metadata.list(path)

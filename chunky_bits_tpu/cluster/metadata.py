@@ -1,0 +1,265 @@
+"""Durable file-reference store.
+
+Mirrors src/cluster/metadata.rs: tag-dispatched ``type: path`` /
+``type: git`` stores (:42-92).  ``MetadataPath`` writes the serialized
+FileReference under a root directory, optionally running a ``put_script``
+via ``/bin/sh -c`` with ``fail_on_script_error`` (:94-141); listing is a
+one-level directory scan with private->public path mapping (:152-205).
+``MetadataGit`` wraps MetadataPath and runs ``git add`` + ``git commit`` per
+write, denying ``.git`` paths (:223-328).  Formats: json, json-pretty
+(default), json-strict, yaml — non-strict variants parse via YAML, a JSON
+superset (:364-414).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from dataclasses import dataclass
+from typing import Optional, Union
+
+import yaml
+
+from chunky_bits_tpu.errors import (
+    LocationError,
+    MetadataReadError,
+    SerdeError,
+)
+from chunky_bits_tpu.file.location import Location
+
+JSON = "json"
+JSON_PRETTY = "json-pretty"
+JSON_STRICT = "json-strict"
+YAML = "yaml"
+FORMATS = (JSON, JSON_PRETTY, JSON_STRICT, YAML)
+
+
+class MetadataFormat:
+    """(metadata.rs:364-414)"""
+
+    def __init__(self, name: str = JSON_PRETTY):
+        if name not in FORMATS:
+            raise SerdeError(f"unknown metadata format {name!r}")
+        self.name = name
+
+    def to_string(self, payload) -> str:
+        if self.name in (JSON, JSON_STRICT):
+            return json.dumps(payload, separators=(",", ":"))
+        if self.name == JSON_PRETTY:
+            return json.dumps(payload, indent=2)
+        return yaml.safe_dump(payload, sort_keys=False)
+
+    def from_bytes(self, data: bytes):
+        try:
+            if self.name == JSON_STRICT:
+                return json.loads(data)
+            return yaml.safe_load(data)
+        except (json.JSONDecodeError, yaml.YAMLError) as err:
+            raise SerdeError(str(err)) from err
+
+    async def from_location(self, location: Union[str, Location],
+                            cx=None):
+        if not isinstance(location, Location):
+            location = Location.parse(str(location))
+        data = await location.read(cx)
+        return self.from_bytes(data)
+
+
+@dataclass
+class FileOrDirectory:
+    """(metadata.rs:417-506)"""
+
+    kind: str  # "file" | "directory"
+    path: str
+
+    def is_file(self) -> bool:
+        return self.kind == "file"
+
+    def is_directory(self) -> bool:
+        return self.kind == "directory"
+
+    def __str__(self) -> str:
+        return self.path
+
+    @staticmethod
+    async def from_local_path(path: str) -> "FileOrDirectory":
+        if await asyncio.to_thread(os.path.isdir, path):
+            return FileOrDirectory("directory", path)
+        if await asyncio.to_thread(os.path.isfile, path):
+            return FileOrDirectory("file", path)
+        raise LocationError(f"not a file or directory: {path}")
+
+    @staticmethod
+    async def list(path: str) -> list["FileOrDirectory"]:
+        """Top-level entry followed by its immediate children."""
+        top = await FileOrDirectory.from_local_path(path)
+        out = [top]
+        if top.is_directory():
+            names = await asyncio.to_thread(sorted, os.listdir(path))
+            for name in names:
+                child = os.path.join(path, name)
+                try:
+                    out.append(await FileOrDirectory.from_local_path(child))
+                except LocationError:
+                    continue
+        return out
+
+
+def _sub_path(root: str, path: str) -> str:
+    """Join, keeping only normal components (no traversal;
+    metadata.rs:197-205)."""
+    parts = [p for p in str(path).split("/")
+             if p not in ("", ".", "..")]
+    return os.path.join(root, *parts) if parts else root
+
+
+def _pub_path(root: str, sub: str) -> str:
+    """Strip the store root off a private path (metadata.rs:174-195)."""
+    rel = os.path.relpath(sub, root)
+    return "." if rel == "." else rel
+
+
+class MetadataPath:
+    """(metadata.rs:94-205)"""
+
+    def __init__(self, path: str, format: Optional[MetadataFormat] = None,
+                 put_script: Optional[str] = None,
+                 fail_on_script_error: bool = False):
+        self.path = str(path)
+        self.format = format or MetadataFormat()
+        self.put_script = put_script
+        self.fail_on_script_error = fail_on_script_error
+
+    async def write(self, path: str, payload) -> None:
+        target = _sub_path(self.path, path)
+        text = self.format.to_string(payload)
+
+        def _write() -> None:
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            with open(target, "w") as f:
+                f.write(text)
+
+        try:
+            await asyncio.to_thread(_write)
+        except OSError as err:
+            raise MetadataReadError(str(err)) from err
+        if self.put_script:
+            proc = await asyncio.create_subprocess_shell(
+                self.put_script, cwd=self.path)
+            code = await proc.wait()
+            if self.fail_on_script_error and code != 0:
+                raise MetadataReadError(
+                    f"put_script exited with code {code}")
+
+    async def read(self, path: str):
+        target = _sub_path(self.path, path)
+
+        def _read() -> bytes:
+            with open(target, "rb") as f:
+                return f.read()
+
+        try:
+            data = await asyncio.to_thread(_read)
+        except OSError as err:
+            raise MetadataReadError(str(err)) from err
+        return self.format.from_bytes(data)
+
+    async def list(self, path: str) -> list[FileOrDirectory]:
+        target = _sub_path(self.path, path)
+        try:
+            entries = await FileOrDirectory.list(target)
+        except LocationError as err:
+            raise MetadataReadError(str(err)) from err
+        return [
+            FileOrDirectory(e.kind, _pub_path(self.path, e.path))
+            for e in entries
+        ]
+
+    def to_obj(self) -> dict:
+        obj = {"type": "path", "format": self.format.name,
+               "path": self.path}
+        if self.put_script is not None:
+            obj["put_script"] = self.put_script
+        if self.fail_on_script_error:
+            obj["fail_on_script_error"] = True
+        return obj
+
+
+def _deny_git(path: str) -> str:
+    first = [p for p in str(path).split("/") if p not in ("", ".")]
+    if first and first[0] == ".git":
+        raise MetadataReadError("Access to .git is denied")
+    return path
+
+
+class MetadataGit:
+    """(metadata.rs:208-329)"""
+
+    def __init__(self, path: str, format: Optional[MetadataFormat] = None):
+        self.meta_path = MetadataPath(path, format)
+
+    @property
+    def path(self) -> str:
+        return self.meta_path.path
+
+    @property
+    def format(self) -> MetadataFormat:
+        return self.meta_path.format
+
+    async def _git(self, *args: str) -> None:
+        proc = await asyncio.create_subprocess_exec(
+            "git", *args, cwd=self.meta_path.path)
+        code = await proc.wait()
+        if code != 0:
+            raise MetadataReadError(f"git {args[0]} exited with {code}")
+
+    async def write(self, path: str, payload) -> None:
+        _deny_git(path)
+        await self.meta_path.write(path, payload)
+        rel = "/".join(p for p in str(path).split("/")
+                       if p not in ("", ".", ".."))
+        await self._git("add", rel)
+        await self._git("commit", "-m", f"Write {rel}")
+
+    async def read(self, path: str):
+        _deny_git(path)
+        return await self.meta_path.read(path)
+
+    async def list(self, path: str) -> list[FileOrDirectory]:
+        _deny_git(path)
+        entries = await self.meta_path.list(path)
+        out = []
+        for e in entries:
+            try:
+                _deny_git(e.path)
+            except MetadataReadError:
+                continue
+            out.append(e)
+        return out
+
+    def to_obj(self) -> dict:
+        return {"type": "git", "format": self.format.name,
+                "path": self.path}
+
+
+MetadataStore = Union[MetadataPath, MetadataGit]
+
+
+def metadata_from_obj(obj: dict) -> MetadataStore:
+    """Tag-dispatched deserialization (metadata.rs:42-48)."""
+    if not isinstance(obj, dict) or "type" not in obj:
+        raise SerdeError("metadata must be a mapping with a 'type' tag")
+    kind = obj["type"]
+    fmt = MetadataFormat(obj["format"]) if "format" in obj else None
+    if kind == "path":
+        return MetadataPath(
+            path=obj["path"],
+            format=fmt,
+            put_script=obj.get("put_script"),
+            fail_on_script_error=bool(obj.get("fail_on_script_error",
+                                              False)),
+        )
+    if kind == "git":
+        return MetadataGit(path=obj["path"], format=fmt)
+    raise SerdeError(f"unknown metadata type {kind!r}")

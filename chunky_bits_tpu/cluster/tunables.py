@@ -1,0 +1,60 @@
+"""Cluster-wide I/O knobs.
+
+Mirrors src/cluster/tunables.rs:52-95: ``https_only`` (default false),
+``on_conflict`` (default ignore — chunk files are content-addressed, so an
+existing file with the right name is already correct), ``user_agent``, plus
+the erasure ``backend`` selection (this framework's addition — the
+north-star's cluster.yaml switch between cpu and TPU erasure backends).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from chunky_bits_tpu.errors import SerdeError
+from chunky_bits_tpu.file.location import IGNORE, OVERWRITE, LocationContext
+
+
+@dataclass
+class Tunables:
+    https_only: bool = False
+    on_conflict: str = IGNORE
+    user_agent: Optional[str] = None
+    backend: Optional[str] = None  # erasure backend name (None = auto)
+
+    def __post_init__(self) -> None:
+        self._location_context = LocationContext(
+            on_conflict=self.on_conflict,
+            https_only=self.https_only,
+            user_agent=self.user_agent,
+        )
+
+    @classmethod
+    def from_obj(cls, obj) -> "Tunables":
+        if obj is None:
+            return cls()
+        if not isinstance(obj, dict):
+            raise SerdeError("tunables must be a mapping")
+        on_conflict = obj.get("on_conflict", IGNORE)
+        if on_conflict not in (IGNORE, OVERWRITE):
+            raise SerdeError(f"invalid on_conflict {on_conflict!r}")
+        return cls(
+            https_only=bool(obj.get("https_only", False)),
+            on_conflict=on_conflict,
+            user_agent=obj.get("user_agent"),
+            backend=obj.get("backend"),
+        )
+
+    def to_obj(self) -> dict:
+        obj = {
+            "https_only": self.https_only,
+            "on_conflict": self.on_conflict,
+            "user_agent": self.user_agent,
+        }
+        if self.backend is not None:
+            obj["backend"] = self.backend
+        return obj
+
+    def location_context(self) -> LocationContext:
+        return self._location_context

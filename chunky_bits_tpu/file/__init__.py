@@ -1,0 +1,41 @@
+"""File codec and I/O substrate (the reference's src/file/ layer)."""
+
+from chunky_bits_tpu.file.chunk import Chunk  # noqa: F401
+from chunky_bits_tpu.file.collection_destination import (  # noqa: F401
+    CollectionDestination,
+    LocationsDestination,
+    ShardWriter,
+    VoidDestination,
+    WeightedLocationsDestination,
+)
+from chunky_bits_tpu.file.file_part import (  # noqa: F401
+    FileIntegrity,
+    FilePart,
+    LocationIntegrity,
+    ResilverPartReport,
+    VerifyPartReport,
+    split_into_shards,
+)
+from chunky_bits_tpu.file.file_reference import (  # noqa: F401
+    FileReference,
+    ResilverFileReport,
+    VerifyFileReport,
+)
+from chunky_bits_tpu.file.hashing import AnyHash, Sha256Hash  # noqa: F401
+from chunky_bits_tpu.file.location import (  # noqa: F401
+    IGNORE,
+    OVERWRITE,
+    Location,
+    LocationContext,
+    Range,
+    default_context,
+)
+from chunky_bits_tpu.file.profiler import (  # noqa: F401
+    ProfileReport,
+    ProfileReporter,
+    Profiler,
+    new_profiler,
+)
+from chunky_bits_tpu.file.reader import FileReadBuilder  # noqa: F401
+from chunky_bits_tpu.file.weighted_location import WeightedLocation  # noqa: F401
+from chunky_bits_tpu.file.writer import FileWriteBuilder  # noqa: F401

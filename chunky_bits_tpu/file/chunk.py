@@ -1,0 +1,41 @@
+"""The unit of placement: a content hash plus the locations holding it.
+
+Wire format matches the reference (src/file/chunk.rs:14-18, hash flattened):
+
+    sha256: <hex>
+    locations: [<location string>, ...]
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from chunky_bits_tpu.errors import SerdeError
+from chunky_bits_tpu.file.hashing import AnyHash
+from chunky_bits_tpu.file.location import Location
+
+
+@dataclass
+class Chunk:
+    hash: AnyHash
+    locations: list[Location] = field(default_factory=list)
+
+    def to_obj(self) -> dict:
+        return {
+            self.hash.algorithm: self.hash.value.hex(),
+            "locations": [str(loc) for loc in self.locations],
+        }
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "Chunk":
+        if not isinstance(obj, dict):
+            raise SerdeError(f"chunk must be a mapping, got {type(obj)}")
+        hash_ = None
+        for algo in ("sha256",):
+            if algo in obj:
+                hash_ = AnyHash.parse(f"{algo}-{obj[algo]}")
+                break
+        if hash_ is None:
+            raise SerdeError(f"chunk has no recognized hash key: {obj}")
+        locations = [Location.parse(s) for s in obj.get("locations", [])]
+        return cls(hash=hash_, locations=locations)

@@ -1,0 +1,206 @@
+"""Whole-file metadata: the durable state of the system.
+
+Wire-compatible with the reference's ``FileReference``
+(src/file/file_reference.rs:38-46; schema documented in README.md:44-60):
+
+    content_type: <optional str>
+    compression:  <optional — reserved>
+    length: <u64>
+    parts:
+      - chunksize: <usize>
+        data:   [{sha256: <hex>, locations: [...]}, ...]
+        parity: [{sha256: <hex>, locations: [...]}, ...]
+
+The reference's Python read-only decoder (python/chunky-bits.py) can read
+references written by this framework unchanged — that is the interop
+contract.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Optional
+
+from chunky_bits_tpu.errors import SerdeError
+from chunky_bits_tpu.file.file_part import (
+    FileIntegrity,
+    FilePart,
+    ResilverPartReport,
+    VerifyPartReport,
+)
+from chunky_bits_tpu.file.location import Location, LocationContext
+
+RESILVER_CONCURRENCY = 10  # parts in flight (file_reference.rs:110)
+
+
+@dataclass
+class FileReference:
+    length: Optional[int]
+    parts: list[FilePart]
+    content_type: Optional[str] = None
+    compression: Optional[str] = None
+
+    def len_bytes(self) -> int:
+        if self.length is not None:
+            return self.length
+        return sum(part.len_bytes() for part in self.parts)
+
+    # ---- serde ----
+
+    def to_obj(self) -> dict:
+        obj: dict = {}
+        if self.compression is not None:
+            obj["compression"] = self.compression
+        if self.content_type is not None:
+            obj["content_type"] = self.content_type
+        obj["length"] = self.length
+        obj["parts"] = [p.to_obj() for p in self.parts]
+        return obj
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "FileReference":
+        if not isinstance(obj, dict) or "parts" not in obj:
+            raise SerdeError("file reference must be a mapping with 'parts'")
+        length = obj.get("length")
+        return cls(
+            length=int(length) if length is not None else None,
+            parts=[FilePart.from_obj(p) for p in obj["parts"]],
+            content_type=obj.get("content_type"),
+            compression=obj.get("compression"),
+        )
+
+    # ---- builders ----
+
+    def read_builder(self, cx: Optional[LocationContext] = None):
+        from chunky_bits_tpu.file.reader import FileReadBuilder
+
+        builder = FileReadBuilder(self)
+        if cx is not None:
+            builder = builder.location_context(cx)
+        return builder
+
+    @staticmethod
+    def write_builder():
+        from chunky_bits_tpu.file.writer import FileWriteBuilder
+
+        return FileWriteBuilder()
+
+    # ---- verify / resilver fan-out (file_reference.rs:78-113) ----
+
+    async def verify(self, cx: Optional[LocationContext] = None
+                     ) -> "VerifyFileReport":
+        reports = await asyncio.gather(
+            *[part.verify(cx) for part in self.parts]
+        )
+        return VerifyFileReport(list(reports))
+
+    async def resilver(self, destination,
+                       cx: Optional[LocationContext] = None
+                       ) -> "ResilverFileReport":
+        sem = asyncio.Semaphore(RESILVER_CONCURRENCY)
+
+        async def one(part: FilePart) -> ResilverPartReport:
+            async with sem:
+                return await part.resilver(destination, cx)
+
+        reports = await asyncio.gather(*[one(p) for p in self.parts])
+        return ResilverFileReport(list(reports))
+
+
+class _FileReportBase:
+    """Roll-ups across parts (file_reference.rs:149-239)."""
+
+    part_reports: list
+
+    def integrity(self) -> FileIntegrity:
+        current = FileIntegrity.VALID
+        for report in self.part_reports:
+            part_integrity = report.integrity()
+            if part_integrity > current:
+                current = part_integrity
+        return current
+
+    def is_ideal(self) -> bool:
+        return self.integrity().is_ideal()
+
+    def is_available(self) -> bool:
+        return self.integrity().is_available()
+
+    def total_parts(self) -> int:
+        return len(self.part_reports)
+
+    def total_chunks(self) -> int:
+        return sum(r.total_chunks() for r in self.part_reports)
+
+    def healthy_parts(self) -> list[FilePart]:
+        return [r.file_part for r in self.part_reports
+                if not r.unhealthy_chunks()]
+
+    def healthy_chunks(self):
+        return [c for r in self.part_reports for c in r.healthy_chunks()]
+
+    def unhealthy_chunks(self):
+        return [c for r in self.part_reports for c in r.unhealthy_chunks()]
+
+    def unavailable_locations(self):
+        return [t for r in self.part_reports
+                for t in r.unavailable_locations()]
+
+    def invalid_locations(self) -> list[Location]:
+        return [loc for r in self.part_reports
+                for loc in r.invalid_locations()]
+
+    def locations_with_integrity(self):
+        for r in self.part_reports:
+            yield from r.locations_with_integrity()
+
+    def display_full_report(self) -> str:
+        out = [f"file\t{self.integrity()}\n"]
+        for r in self.part_reports:
+            out.append(r.display_full_report())
+        return "\n".join(out)
+
+
+class VerifyFileReport(_FileReportBase):
+    def __init__(self, part_reports: list[VerifyPartReport]):
+        self.part_reports = part_reports
+
+    def __str__(self) -> str:
+        # The reference prints the *healthy* count under the "unhealthy"
+        # label (file_reference.rs:243-252); corrected here.
+        unhealthy = self.total_parts() - len(self.healthy_parts())
+        return (
+            f"{self.integrity()}: {unhealthy}/"
+            f"{self.total_parts()} unhealthy parts"
+        )
+
+
+class ResilverFileReport(_FileReportBase):
+    def __init__(self, part_reports: list[ResilverPartReport]):
+        self.part_reports = part_reports
+
+    def rebuild_errors(self) -> list[Optional[str]]:
+        return [r.rebuild_error() for r in self.part_reports]
+
+    def new_locations(self) -> list[Location]:
+        return [loc for r in self.part_reports for loc in r.new_locations()]
+
+    def successful_writes(self):
+        return [w for r in self.part_reports for w in r.successful_writes()]
+
+    def failed_writes(self) -> list[str]:
+        return [e for r in self.part_reports for e in r.failed_writes()]
+
+    def resilvered_parts(self) -> list[FilePart]:
+        return [r.file_part for r in self.part_reports]
+
+    def modified_parts(self) -> list[FilePart]:
+        return [r.file_part for r in self.part_reports
+                if r.successful_writes()]
+
+    def __str__(self) -> str:
+        return (
+            f"{self.integrity()}: {len(self.modified_parts())}/"
+            f"{self.total_parts()} parts modified"
+        )

@@ -1,0 +1,109 @@
+"""Per-operation I/O telemetry.
+
+Mirrors the reference profiler (src/file/profiler.rs): reads and writes are
+logged with result, location, byte length and start/end times; a reporter
+drains the log into a ``ProfileReport`` exposing average read/write durations
+and wall/byte totals (profiler.rs:240-329).  A thread-safe in-memory log
+replaces the reference's unbounded-channel collector task — same observable
+API, no background task to leak.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class ResultLog:
+    kind: str  # "read" | "write"
+    ok: bool
+    error: Optional[str]
+    location: object
+    length: int  # bytes moved (read: bytes returned; write: bytes sent)
+    start_time: float
+    end_time: float
+
+    @property
+    def duration(self) -> float:
+        return self.end_time - self.start_time
+
+
+class Profiler:
+    """Handed to a LocationContext; log_* is called at the two I/O hooks
+    (reference: src/file/location.rs:109-112,240-242)."""
+
+    def __init__(self) -> None:
+        self._entries: list[ResultLog] = []
+        self._lock = threading.Lock()
+
+    def log_read(self, ok: bool, error: Optional[str], location,
+                 length: int, start_time: float) -> None:
+        entry = ResultLog("read", ok, error, location, length,
+                          start_time, time.monotonic())
+        with self._lock:
+            self._entries.append(entry)
+
+    def log_write(self, ok: bool, error: Optional[str], location,
+                  length: int, start_time: float) -> None:
+        entry = ResultLog("write", ok, error, location, length,
+                          start_time, time.monotonic())
+        with self._lock:
+            self._entries.append(entry)
+
+    def drain(self) -> list[ResultLog]:
+        with self._lock:
+            out, self._entries = self._entries, []
+        return out
+
+
+class ProfileReport:
+    def __init__(self, entries: list[ResultLog]):
+        self.entries = entries
+
+    def _avg(self, kind: str) -> Optional[float]:
+        durations = [e.duration for e in self.entries if e.kind == kind]
+        if not durations:
+            return None
+        return sum(durations) / len(durations)
+
+    def average_read_duration(self) -> Optional[float]:
+        return self._avg("read")
+
+    def average_write_duration(self) -> Optional[float]:
+        return self._avg("write")
+
+    def total_time(self) -> Optional[float]:
+        if not self.entries:
+            return None
+        return self.entries[-1].end_time - self.entries[0].start_time
+
+    def total_bytes(self) -> int:
+        return sum(e.length for e in self.entries if e.ok)
+
+    def __str__(self) -> str:
+        def ms(v: Optional[float]) -> str:
+            return "None" if v is None else str(int(v * 1000))
+
+        return (
+            f"ReadAvg<{ms(self.average_read_duration())}ms> "
+            f"WriteAvg<{ms(self.average_write_duration())}ms> "
+            f"Total<{ms(self.total_time())}ms> Total<{self.total_bytes()}B>"
+        )
+
+
+class ProfileReporter:
+    """Pairs with a Profiler (reference: new_profiler(), profiler.rs:33-65)."""
+
+    def __init__(self, profiler: Profiler):
+        self._profiler = profiler
+
+    def profile(self) -> ProfileReport:
+        return ProfileReport(self._profiler.drain())
+
+
+def new_profiler() -> tuple[Profiler, ProfileReporter]:
+    p = Profiler()
+    return p, ProfileReporter(p)

@@ -1,0 +1,1 @@
+"""Host-plane utilities: async byte streams, serde helpers."""

@@ -1,0 +1,210 @@
+"""Async byte-stream primitives for the host I/O plane.
+
+The reference's concurrency substrate is tokio (AsyncRead/AsyncWrite +
+blocking pool; reference: src/bin/chunky-bits/util.rs:14-59 for the
+double-buffered copy).  Here the substrate is asyncio: filesystem work hops
+to threads (the blocking-pool analogue), and byte streams are objects with
+``async read(n)``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import os
+from typing import AsyncIterator, Optional, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class AsyncByteReader(Protocol):
+    """Anything with ``async read(n) -> bytes`` (b'' at EOF)."""
+
+    async def read(self, n: int = -1) -> bytes:  # pragma: no cover
+        ...
+
+
+class BytesReader:
+    """In-memory reader."""
+
+    def __init__(self, data: bytes):
+        self._buf = io.BytesIO(data)
+
+    async def read(self, n: int = -1) -> bytes:
+        return self._buf.read(n)
+
+
+class FileReader:
+    """Thread-offloaded file reader (the spawn_blocking analogue)."""
+
+    def __init__(self, path: str, offset: int = 0,
+                 fileobj: Optional[io.BufferedReader] = None):
+        self._path = path
+        self._f = fileobj
+        self._offset = offset
+
+    async def _ensure(self) -> io.BufferedReader:
+        if self._f is None:
+            f = await asyncio.to_thread(open, self._path, "rb")
+            if self._offset:
+                await asyncio.to_thread(f.seek, self._offset)
+            self._f = f
+        return self._f
+
+    async def read(self, n: int = -1) -> bytes:
+        f = await self._ensure()
+        return await asyncio.to_thread(f.read, n)
+
+    async def close(self) -> None:
+        if self._f is not None:
+            await asyncio.to_thread(self._f.close)
+            self._f = None
+
+
+async def close_reader(reader) -> None:
+    """Close a reader if it supports closing (releases pooled HTTP
+    connections for consumers that stop before EOF)."""
+    close = getattr(reader, "close", None)
+    if close is not None:
+        result = close()
+        if hasattr(result, "__await__"):
+            await result
+
+
+class TakeReader:
+    """Limit an underlying reader to ``length`` bytes (tokio's ``take``).
+    Closes the inner reader once the limit is reached, since the consumer
+    will never drive it to EOF."""
+
+    def __init__(self, inner: AsyncByteReader, length: int):
+        self._inner = inner
+        self._remaining = length
+
+    async def read(self, n: int = -1) -> bytes:
+        if self._remaining <= 0:
+            return b""
+        if n < 0 or n > self._remaining:
+            n = self._remaining
+        data = await self._inner.read(n)
+        self._remaining -= len(data)
+        if self._remaining <= 0 or not data:
+            await close_reader(self._inner)
+        return data
+
+    async def close(self) -> None:
+        await close_reader(self._inner)
+
+
+class ZeroExtendReader:
+    """After EOF on the inner reader, keep yielding zeros up to ``length``
+    total bytes (the reference's ``chain(repeat(0)).take(len)`` —
+    src/file/location.rs:128)."""
+
+    def __init__(self, inner: AsyncByteReader, length: int):
+        self._inner = inner
+        self._remaining = length
+        self._eof = False
+
+    async def read(self, n: int = -1) -> bytes:
+        if self._remaining <= 0:
+            return b""
+        if n < 0 or n > self._remaining:
+            n = self._remaining
+        if not self._eof:
+            data = await self._inner.read(n)
+            if data:
+                self._remaining -= len(data)
+                if self._remaining <= 0:
+                    await close_reader(self._inner)
+                return data
+            self._eof = True
+            await close_reader(self._inner)
+        out = b"\0" * n
+        self._remaining -= n
+        return out
+
+    async def close(self) -> None:
+        await close_reader(self._inner)
+
+
+class IterReader:
+    """Adapt an async iterator of byte chunks into a reader."""
+
+    def __init__(self, it: AsyncIterator[bytes]):
+        self._it = it
+        self._pending = b""
+        self._eof = False
+
+    async def read(self, n: int = -1) -> bytes:
+        if self._eof and not self._pending:
+            return b""
+        while n < 0 or len(self._pending) < n:
+            try:
+                chunk = await self._it.__anext__()
+            except StopAsyncIteration:
+                self._eof = True
+                break
+            self._pending += chunk
+        if n < 0 or len(self._pending) <= n:
+            out, self._pending = self._pending, b""
+        else:
+            out, self._pending = self._pending[:n], self._pending[n:]
+        return out
+
+
+async def read_exact_or_eof(reader: AsyncByteReader, n: int) -> bytes:
+    """Read exactly n bytes unless EOF comes first (the reference's
+    read-exact-but-handle-EOF loop, src/file/writer.rs:175-193)."""
+    chunks: list[bytes] = []
+    got = 0
+    while got < n:
+        data = await reader.read(n - got)
+        if not data:
+            break
+        chunks.append(data)
+        got += len(data)
+    return b"".join(chunks)
+
+
+async def copy_reader_to_file(reader: AsyncByteReader, path: str,
+                              chunk: int = 1 << 20) -> int:
+    """Streaming copy with thread-offloaded writes; returns bytes copied."""
+    total = 0
+    f = await asyncio.to_thread(open, path, "wb")
+    try:
+        while True:
+            data = await reader.read(chunk)
+            if not data:
+                break
+            await asyncio.to_thread(f.write, data)
+            total += len(data)
+        await asyncio.to_thread(f.flush)
+    finally:
+        await asyncio.to_thread(f.close)
+    return total
+
+
+async def copy_reader_to_writer(reader: AsyncByteReader, write,
+                                chunk: int = 1 << 20) -> int:
+    """Copy to an ``async write(bytes)`` callable; the io_copy analogue
+    (reference: src/bin/chunky-bits/util.rs:14-59) — double buffering comes
+    from the event loop interleaving read and write tasks."""
+    total = 0
+    pending: Optional[asyncio.Task] = None
+    try:
+        while True:
+            data = await reader.read(chunk)
+            if pending is not None:
+                await pending
+                pending = None
+            if not data:
+                break
+            pending = asyncio.ensure_future(write(data))
+            total += len(data)
+    finally:
+        if pending is not None:
+            await pending
+    return total
+
+
+def fs_path_join(base: str, name: str) -> str:
+    return os.path.join(base, name)

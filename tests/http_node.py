@@ -1,0 +1,74 @@
+"""In-process fake HTTP storage node for tests.
+
+The analogue of the reference's warp-over-HashMap fake node
+(tests/location.rs:16-99): GET/HEAD/PUT/DELETE over an in-memory dict, with
+single-range GET support.  Uses an ephemeral port (the reference pins ports
+64000-64005; ephemeral is race-free)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+
+class FakeHttpNode:
+    def __init__(self) -> None:
+        self.store: dict[str, bytes] = {}
+        self._runner = None
+        self.port: int = 0
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    async def _get(self, request: web.Request) -> web.Response:
+        key = request.match_info["key"]
+        data = self.store.get(key)
+        if data is None:
+            return web.Response(status=404)
+        range_header = request.headers.get("Range")
+        if range_header and range_header.startswith("bytes="):
+            spec = range_header[len("bytes="):]
+            start_s, _, end_s = spec.partition("-")
+            start = int(start_s) if start_s else 0
+            end = int(end_s) if end_s else len(data) - 1
+            if start >= len(data):
+                return web.Response(status=416)
+            body = data[start: end + 1]
+            return web.Response(
+                status=206,
+                body=body,
+                headers={
+                    "Content-Range":
+                        f"bytes {start}-{start + len(body) - 1}/{len(data)}"
+                },
+            )
+        return web.Response(body=data)
+
+    async def _put(self, request: web.Request) -> web.Response:
+        key = request.match_info["key"]
+        if key.startswith("fail/"):  # simulated full/broken disk
+            return web.Response(status=507)
+        self.store[key] = await request.read()
+        return web.Response()
+
+    async def _delete(self, request: web.Request) -> web.Response:
+        key = request.match_info["key"]
+        self.store.pop(key, None)
+        return web.Response()
+
+    async def start(self) -> "FakeHttpNode":
+        app = web.Application()
+        app.router.add_get("/{key:.*}", self._get)  # also serves HEAD
+        app.router.add_put("/{key:.*}", self._put)
+        app.router.add_delete("/{key:.*}", self._delete)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

@@ -1,0 +1,343 @@
+"""Cluster layer tests (mirror of reference tests/cluster.rs plus coverage
+for what the reference leaves untested: zone rules, profile inheritance,
+metadata git)."""
+
+import asyncio
+import os
+import random
+
+import pytest
+import yaml
+
+from chunky_bits_tpu.cluster import (
+    Cluster,
+    ClusterNodes,
+    ClusterProfiles,
+    MetadataGit,
+)
+from chunky_bits_tpu.errors import (
+    MetadataReadError,
+    NotEnoughWriters,
+    SerdeError,
+)
+from chunky_bits_tpu.file import FileIntegrity, FileReadBuilder, new_profiler
+from chunky_bits_tpu.utils import aio
+
+# the examples/test.yaml shape with paths rewritten into tempdirs
+# (tests/cluster.rs:63-103)
+TEST_CLUSTER_YAML = """
+destinations:
+  - location: {repo}
+    repeat: 99
+metadata:
+  type: path
+  format: yaml
+  path: {metadata}
+profiles:
+  default:
+    data: 3
+    parity: 2
+"""
+
+
+def make_cluster(tmp_path, repeat=99) -> Cluster:
+    repo = tmp_path / "repo"
+    meta = tmp_path / "metadata"
+    repo.mkdir(exist_ok=True)
+    meta.mkdir(exist_ok=True)
+    text = TEST_CLUSTER_YAML.format(repo=repo, metadata=meta)
+    cluster = Cluster.from_obj(yaml.safe_load(text))
+    cluster.destinations.nodes[0].repeat = repeat
+    return cluster
+
+
+def synthetic_reader(n: int, seed: int = 0) -> aio.BytesReader:
+    rng = random.Random(seed)
+    return aio.BytesReader(bytes(rng.getrandbits(8) for _ in range(n)))
+
+
+def test_cluster_from_yaml_examples(tmp_path):
+    """All reference example shapes must parse (CI validate-example-clusters
+    analogue)."""
+    for name in ("local", "weights", "zones", "git", "test"):
+        with open(f"/root/reference/examples/{name}.yaml") as f:
+            obj = yaml.safe_load(f)
+        cluster = Cluster.from_obj(obj)
+        assert cluster.get_profile() is not None
+
+
+def test_zone_map_flattening():
+    nodes = ClusterNodes.from_obj({
+        "ssd": [{"location": "/mnt/ssd1"}, {"location": "/mnt/ssd2"}],
+        "offsite": {"location": "http://remote/repo"},
+    })
+    assert len(nodes) == 3
+    zones = {str(n.location.location): n.zones for n in nodes}
+    assert zones["/mnt/ssd1"] == {"ssd"}
+    assert zones["http://remote/repo"] == {"offsite"}
+
+
+def test_profile_inheritance():
+    profiles = ClusterProfiles.from_obj({
+        "default": {
+            "data": 3, "parity": 2, "chunk_size": 20,
+            "rules": {"ssd": {"minimum": 1, "maximum": None, "ideal": 2}},
+        },
+        "lowlatency": {"parity": 0,
+                       "rules": {"ssd": None}},
+        "wide": {"data": 10},
+    })
+    low = profiles.get("lowlatency")
+    assert low.data_chunks == 3  # inherited
+    assert low.parity_chunks == 0  # overridden
+    assert low.zone_rules == {}  # null removes inherited rule
+    wide = profiles.get("wide")
+    assert wide.data_chunks == 10
+    assert wide.parity_chunks == 2
+    assert wide.zone_rules["ssd"].ideal == 2
+    assert profiles.get("DEFAULT") is profiles.get_default()
+    assert profiles.get("missing") is None
+
+
+def test_profiles_require_default():
+    with pytest.raises(SerdeError):
+        ClusterProfiles.from_obj({"custom": {"data": 1, "parity": 0}})
+
+
+def test_cluster_write_read(tmp_path):
+    cluster = make_cluster(tmp_path)
+
+    async def main():
+        payload_reader = synthetic_reader(1 << 20, seed=1)
+        profile = cluster.get_profile()
+        profile.chunk_size = 16  # 64 KiB chunks for speed
+        await cluster.write_file("some-file", payload_reader, profile)
+        ref = await cluster.get_file_ref("some-file")
+        assert ref.length == 1 << 20
+        got = await FileReadBuilder(ref).read_all()
+        assert got == bytes(synthetic_bytes_list(1 << 20, seed=1))
+        files = await cluster.list_files(".")
+        names = {f.path for f in files if f.is_file()}
+        assert "some-file" in names
+
+    asyncio.run(main())
+
+
+def synthetic_bytes_list(n, seed):
+    rng = random.Random(seed)
+    return bytes(rng.getrandbits(8) for _ in range(n))
+
+
+def test_not_enough_writers_via_repeat(tmp_path):
+    """(tests/cluster.rs:122-143)"""
+    cluster = make_cluster(tmp_path, repeat=3)  # 4 slots < 5 needed
+
+    async def main():
+        profile = cluster.get_profile()
+        profile.chunk_size = 16
+        with pytest.raises(NotEnoughWriters):
+            await cluster.write_file(
+                "toobig", synthetic_reader(100000), profile)
+
+    asyncio.run(main())
+
+
+def test_delete_and_resilver(tmp_path):
+    """The core conformance test (tests/cluster.rs:145-231): delete 1 data
+    + 1 parity chunk per part, assert Degraded-but-available, resilver,
+    assert Valid/Resilvered and new_locations == deleted count."""
+    cluster = make_cluster(tmp_path)
+
+    async def main():
+        profile = cluster.get_profile()
+        profile.chunk_size = 16
+        await cluster.write_file(
+            "resilver-me", synthetic_reader(1 << 19, seed=7), profile)
+        ref = await cluster.get_file_ref("resilver-me")
+
+        deleted = 0
+        for part in ref.parts:
+            os.remove(part.data[0].locations[0].target)
+            os.remove(part.parity[0].locations[0].target)
+            deleted += 2
+
+        verify = await ref.verify()
+        assert verify.integrity() == FileIntegrity.DEGRADED
+        assert verify.is_available()
+        assert not verify.is_ideal()
+
+        dest = cluster.get_destination(profile)
+        report = await ref.resilver(dest)
+        assert report.integrity() == FileIntegrity.RESILVERED
+        assert len(report.new_locations()) == deleted
+
+        # after resilver everything verifies Valid again
+        verify2 = await ref.verify()
+        assert verify2.integrity() == FileIntegrity.VALID
+
+        # and the file still reads back byte-identical
+        got = await FileReadBuilder(ref).read_all()
+        assert got == synthetic_bytes_list(1 << 19, seed=7)
+
+    asyncio.run(main())
+
+
+def test_write_profiler_bounds(tmp_path):
+    """(tests/cluster.rs:233-251)"""
+    cluster = make_cluster(tmp_path)
+
+    async def main():
+        profile = cluster.get_profile()
+        profile.chunk_size = 16
+        report, _ref = await cluster.write_file_with_report(
+            "profiled", synthetic_reader(1 << 18), profile)
+        avg = report.average_write_duration()
+        assert avg is not None
+        assert 0 < avg < 1.0
+        assert report.total_bytes() > 0
+
+    asyncio.run(main())
+
+
+def test_zone_rules_ideal_and_required(tmp_path):
+    """Zone placement coverage the reference lacks: ideal forces the first
+    placements into a zone; minimum requires them."""
+    ssd_dirs, hdd_dirs = [], []
+    for i in range(5):
+        d = tmp_path / f"ssd{i}"
+        d.mkdir()
+        ssd_dirs.append(str(d))
+        d = tmp_path / f"hdd{i}"
+        d.mkdir()
+        hdd_dirs.append(str(d))
+    meta = tmp_path / "meta"
+    meta.mkdir()
+    obj = {
+        "destinations": {
+            "ssd": [{"location": p} for p in ssd_dirs],
+            "hdd": [{"location": p} for p in hdd_dirs],
+        },
+        "metadata": {"type": "path", "format": "yaml", "path": str(meta)},
+        "profiles": {
+            "default": {
+                "data": 2, "parity": 1, "chunk_size": 16,
+                "rules": {"ssd": {"minimum": 0, "maximum": None,
+                                  "ideal": 3}},
+            },
+            "pinned": {
+                "rules": {"ssd": {"minimum": 3, "maximum": None,
+                                  "ideal": 0}},
+            },
+        },
+    }
+    cluster = Cluster.from_obj(obj)
+
+    async def main():
+        # ideal: all 3 shards of each part land on ssd
+        await cluster.write_file(
+            "ideal", synthetic_reader(100000, seed=2),
+            cluster.get_profile())
+        ref = await cluster.get_file_ref("ideal")
+        for part in ref.parts:
+            for chunk in part.data + part.parity:
+                assert "/ssd" in chunk.locations[0].target
+        # minimum: same but via the required branch
+        await cluster.write_file(
+            "required", synthetic_reader(100000, seed=3),
+            cluster.get_profile("pinned"))
+        ref = await cluster.get_file_ref("required")
+        for part in ref.parts:
+            for chunk in part.data + part.parity:
+                assert "/ssd" in chunk.locations[0].target
+
+    asyncio.run(main())
+
+
+def test_zone_rules_maximum(tmp_path):
+    """maximum budget: no more than N shards per part in a zone."""
+    ssd_dirs, hdd_dirs = [], []
+    for i in range(5):
+        d = tmp_path / f"ssd{i}"
+        d.mkdir()
+        ssd_dirs.append(str(d))
+        d = tmp_path / f"hdd{i}"
+        d.mkdir()
+        hdd_dirs.append(str(d))
+    meta = tmp_path / "meta"
+    meta.mkdir()
+    obj = {
+        "destinations": {
+            "ssd": [{"location": p} for p in ssd_dirs],
+            "hdd": [{"location": p} for p in hdd_dirs],
+        },
+        "metadata": {"type": "path", "format": "yaml", "path": str(meta)},
+        "profiles": {
+            "default": {
+                "data": 3, "parity": 2, "chunk_size": 16,
+                "rules": {"ssd": {"minimum": 0, "maximum": 1, "ideal": 0}},
+            },
+        },
+    }
+    cluster = Cluster.from_obj(obj)
+
+    async def main():
+        await cluster.write_file(
+            "capped", synthetic_reader(100000, seed=4),
+            cluster.get_profile())
+        ref = await cluster.get_file_ref("capped")
+        for part in ref.parts:
+            ssd_count = sum(
+                1 for chunk in part.data + part.parity
+                if "/ssd" in chunk.locations[0].target
+            )
+            assert ssd_count <= 1, f"zone maximum violated: {ssd_count}"
+
+    asyncio.run(main())
+
+
+def test_metadata_git(tmp_path):
+    """MetadataGit commits every write (untested in the reference)."""
+    gitdir = tmp_path / "gitmeta"
+    gitdir.mkdir()
+
+    async def main():
+        proc = await asyncio.create_subprocess_exec(
+            "git", "init", "-q", cwd=str(gitdir))
+        assert await proc.wait() == 0
+        for args in (["config", "user.email", "test@test"],
+                     ["config", "user.name", "test"]):
+            proc = await asyncio.create_subprocess_exec(
+                "git", *args, cwd=str(gitdir))
+            assert await proc.wait() == 0
+        meta = MetadataGit(str(gitdir))
+        await meta.write("obj1", {"length": 1, "parts": []})
+        assert (await meta.read("obj1"))["length"] == 1
+        with pytest.raises(MetadataReadError):
+            await meta.read(".git/config")
+        proc = await asyncio.create_subprocess_exec(
+            "git", "log", "--oneline", cwd=str(gitdir),
+            stdout=asyncio.subprocess.PIPE)
+        out, _ = await proc.communicate()
+        assert b"Write obj1" in out
+
+    asyncio.run(main())
+
+
+def test_metadata_put_script(tmp_path):
+    meta_dir = tmp_path / "meta"
+    meta_dir.mkdir()
+    marker = tmp_path / "marker"
+
+    async def main():
+        from chunky_bits_tpu.cluster import MetadataPath
+
+        meta = MetadataPath(
+            str(meta_dir), put_script=f"touch {marker}")
+        await meta.write("f", {"length": 0, "parts": []})
+        assert marker.exists()
+        failing = MetadataPath(str(meta_dir), put_script="exit 3",
+                               fail_on_script_error=True)
+        with pytest.raises(MetadataReadError):
+            await failing.write("g", {"length": 0, "parts": []})
+
+    asyncio.run(main())

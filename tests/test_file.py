@@ -1,0 +1,188 @@
+"""File codec tests (mirror of reference tests/file.rs): write-path
+part/length math over the d x p grid, NotEnoughWriters, read-side profiler,
+plus full write->read roundtrips and the TPU batch staging path."""
+
+import asyncio
+import hashlib
+import os
+import random
+
+import pytest
+
+from chunky_bits_tpu.errors import NotEnoughWriters
+from chunky_bits_tpu.file import (
+    FileReadBuilder,
+    FileReference,
+    FileWriteBuilder,
+    Location,
+    LocationContext,
+    LocationsDestination,
+    VoidDestination,
+    new_profiler,
+)
+from chunky_bits_tpu.utils import aio
+
+CHUNK_SIZE = 1 << 16
+LENGTH = (1 << 18) + 7  # not divisible by any stripe size (cf. tests/file.rs)
+
+
+def synthetic_bytes(n: int, seed: int = 0) -> bytes:
+    rng = random.Random(seed)
+    return bytes(rng.getrandbits(8) for _ in range(n))
+
+
+@pytest.mark.parametrize("d", [1, 2, 3])
+@pytest.mark.parametrize("p", [1, 2, 3])
+def test_write_part_length_math(d, p):
+    """Mirrors tests/file.rs:26-56: part count and chunk sizes over the
+    void destination."""
+    payload = synthetic_bytes(LENGTH, seed=d * 10 + p)
+
+    async def main():
+        builder = (FileWriteBuilder()
+                   .with_destination(VoidDestination())
+                   .with_chunk_size(CHUNK_SIZE)
+                   .with_data_chunks(d)
+                   .with_parity_chunks(p))
+        ref = await builder.write(aio.BytesReader(payload))
+        assert ref.length == LENGTH
+        part_size = d * CHUNK_SIZE
+        expected_parts = (LENGTH + part_size - 1) // part_size
+        assert len(ref.parts) == expected_parts
+        for part in ref.parts[:-1]:
+            assert part.chunksize == CHUNK_SIZE
+            assert len(part.data) == d
+            assert len(part.parity) == p
+        last = ref.parts[-1]
+        tail = LENGTH - (expected_parts - 1) * part_size
+        assert last.chunksize == (tail + d - 1) // d
+        # chunks carry real hashes but no locations (void)
+        for part in ref.parts:
+            for chunk in part.data + part.parity:
+                assert chunk.locations == []
+
+    asyncio.run(main())
+
+
+def test_not_enough_writers(tmp_path):
+    """Mirrors tests/file.rs:58-111."""
+    dirs = [tmp_path / f"d{i}" for i in range(3)]
+    for dpath in dirs:
+        dpath.mkdir()
+
+    async def main():
+        dest = LocationsDestination([Location.parse(str(d)) for d in dirs])
+        builder = (FileWriteBuilder()
+                   .with_destination(dest)
+                   .with_chunk_size(CHUNK_SIZE)
+                   .with_data_chunks(3)
+                   .with_parity_chunks(2))
+        with pytest.raises(NotEnoughWriters):
+            await builder.write(aio.BytesReader(b"x" * 1000))
+
+    asyncio.run(main())
+
+
+@pytest.mark.parametrize("batch_parts", [1, 4])
+def test_roundtrip_with_storage(tmp_path, batch_parts):
+    payload = synthetic_bytes(LENGTH, seed=99)
+    dirs = []
+    for i in range(5):
+        d = tmp_path / f"disk{i}"
+        d.mkdir()
+        dirs.append(Location.parse(str(d)))
+
+    async def main():
+        dest = LocationsDestination(dirs)
+        builder = (FileWriteBuilder()
+                   .with_destination(dest)
+                   .with_chunk_size(CHUNK_SIZE)
+                   .with_data_chunks(3)
+                   .with_parity_chunks(2)
+                   .with_batch_parts(batch_parts))
+        ref = await builder.write(aio.BytesReader(payload))
+        # serde roundtrip preserves everything
+        ref2 = FileReference.from_obj(ref.to_obj())
+        got = await FileReadBuilder(ref2).read_all()
+        assert hashlib.sha256(got).hexdigest() == \
+            hashlib.sha256(payload).hexdigest()
+        # seek/take
+        got = await FileReadBuilder(ref2).with_seek(100).with_take(
+            5000).read_all()
+        assert got == payload[100:5100]
+        # seek across part boundaries
+        offset = 3 * CHUNK_SIZE + 17
+        got = await FileReadBuilder(ref2).with_seek(offset).read_all()
+        assert got == payload[offset:]
+        # take beyond EOF
+        got = await FileReadBuilder(ref2).with_seek(LENGTH - 10).with_take(
+            100).read_all()
+        assert got == payload[-10:]
+
+    asyncio.run(main())
+
+
+def test_read_survives_chunk_loss(tmp_path):
+    payload = synthetic_bytes(200000, seed=5)
+    dirs = []
+    for i in range(5):
+        d = tmp_path / f"disk{i}"
+        d.mkdir()
+        dirs.append(Location.parse(str(d)))
+
+    async def main():
+        dest = LocationsDestination(dirs)
+        builder = (FileWriteBuilder()
+                   .with_destination(dest)
+                   .with_chunk_size(CHUNK_SIZE)
+                   .with_data_chunks(3)
+                   .with_parity_chunks(2))
+        ref = await builder.write(aio.BytesReader(payload))
+        # delete up to p chunk files per part (1 data + 1 parity)
+        for part in ref.parts:
+            os.remove(part.data[0].locations[0].target)
+            os.remove(part.parity[0].locations[0].target)
+        got = await FileReadBuilder(ref).read_all()
+        assert got == payload
+
+    asyncio.run(main())
+
+
+def test_read_profiler(tmp_path):
+    """Mirrors tests/file.rs:113-141."""
+    payload = synthetic_bytes(100000, seed=1)
+    dirs = []
+    for i in range(5):
+        d = tmp_path / f"disk{i}"
+        d.mkdir()
+        dirs.append(Location.parse(str(d)))
+
+    async def main():
+        dest = LocationsDestination(dirs)
+        ref = await (FileWriteBuilder()
+                     .with_destination(dest)
+                     .with_chunk_size(CHUNK_SIZE)
+                     .with_data_chunks(3)
+                     .with_parity_chunks(2)
+                     ).write(aio.BytesReader(payload))
+        profiler, reporter = new_profiler()
+        cx = LocationContext(profiler=profiler)
+        got = await FileReadBuilder(ref).location_context(cx).read_all()
+        assert got == payload
+        report = reporter.profile()
+        assert report.average_read_duration() is not None
+        assert report.average_read_duration() < 1.0
+        assert report.total_bytes() > 0
+
+    asyncio.run(main())
+
+
+def test_write_empty_file():
+    async def main():
+        ref = await (FileWriteBuilder()
+                     .with_destination(VoidDestination())
+                     ).write(aio.BytesReader(b""))
+        assert ref.length == 0
+        assert ref.parts == []
+
+    asyncio.run(main())

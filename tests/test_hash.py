@@ -1,0 +1,43 @@
+"""Hash KATs (mirrors reference tests/hash.rs)."""
+
+import asyncio
+
+import pytest
+
+from chunky_bits_tpu.errors import SerdeError
+from chunky_bits_tpu.file.hashing import AnyHash, Sha256Hash
+
+
+def test_sha256_known_answer():
+    h = Sha256Hash.from_buf(b"hello world")
+    assert h.hex() == (
+        "b94d27b9934d3e08a52e52d7da7dabfac484efe37a5380ee9088f7ace2efcde9"
+    )
+    assert h.verify(b"hello world")
+    assert not h.verify(b"hello worlD")
+
+
+def test_any_hash_roundtrip():
+    h = AnyHash.from_buf(b"data")
+    s = str(h)
+    assert s.startswith("sha256-")
+    assert AnyHash.parse(s) == h
+
+
+def test_any_hash_parse_errors():
+    with pytest.raises(SerdeError):
+        AnyHash.parse("md5-abcdef")
+    with pytest.raises(SerdeError):
+        AnyHash.parse("nodash")
+    with pytest.raises(SerdeError):
+        AnyHash.parse("sha256-zz")
+
+
+def test_async_hashing_roundtrip():
+    async def main():
+        h = AnyHash.from_buf(b"stream me")
+        assert await h.verify_async(b"stream me")
+        assert not await h.verify_async(b"other")
+        assert await h.rehash_async(b"x") == AnyHash.from_buf(b"x")
+
+    asyncio.run(main())

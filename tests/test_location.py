@@ -1,0 +1,244 @@
+"""Location substrate tests (mirror of reference tests/location.rs):
+FS + HTTP read, subfile write, streaming, conflict policy, ranges,
+parse/display."""
+
+import asyncio
+import os
+
+import pytest
+
+from chunky_bits_tpu.errors import (
+    HttpStatusError,
+    LocationError,
+    LocationParseError,
+    WriteToRangeError,
+)
+from chunky_bits_tpu.file.hashing import AnyHash
+from chunky_bits_tpu.file.location import (
+    IGNORE,
+    Location,
+    LocationContext,
+    Range,
+)
+from chunky_bits_tpu.utils import aio
+from tests.http_node import FakeHttpNode
+
+
+def test_parse_display_roundtrip():
+    cases = [
+        "/tmp/some/path",
+        "relative/path",
+        "http://example.com/data",
+        "https://example.com/data",
+        "(5,10)/tmp/file",
+        "(5,)/tmp/file",
+        "(0,0128)/tmp/file",
+        "(7,12)http://example.com/x",
+    ]
+    for s in cases:
+        loc = Location.parse(s)
+        assert str(loc) == s, s
+
+
+def test_parse_range_semantics():
+    loc = Location.parse("(5,10)/tmp/file")
+    assert loc.range == Range(5, 10, False)
+    loc = Location.parse("(5,)/tmp/file")
+    assert loc.range == Range(5, None, False)
+    loc = Location.parse("(0,0128)/tmp/file")
+    assert loc.range == Range(0, 128, True)
+    # no valid prefix -> the parens belong to the path
+    loc = Location.parse("(x,y)/tmp/file")
+    assert loc.target == "(x,y)/tmp/file"
+
+
+def test_parse_file_url():
+    loc = Location.parse("file:///tmp/abc")
+    assert loc.is_local() and loc.target == "/tmp/abc"
+
+
+def test_parse_errors():
+    with pytest.raises(LocationParseError):
+        Location.parse("")
+    with pytest.raises(LocationParseError):
+        Location.http("ftp://example.com/x")
+
+
+def test_child_and_parent():
+    base = Location.parse("/tmp/dir")
+    child = base.child("abc")
+    assert str(child) == "/tmp/dir/abc"
+    assert child.is_child_of(base)
+    assert base.is_parent_of(child)
+    hbase = Location.parse("http://example.com/data")
+    hchild = hbase.child("abc")
+    assert str(hchild) == "http://example.com/data/abc"
+    assert hchild.is_child_of(hbase)
+    assert not hchild.is_child_of(base)
+
+
+def test_fs_read(tmp_path):
+    # the reference uses /bin/sh as an always-present file
+    # (tests/location.rs:101-107); a tempfile is equivalent and hermetic
+    path = tmp_path / "content"
+    path.write_bytes(b"some test content")
+
+    async def main():
+        loc = Location.parse(str(path))
+        assert await loc.read() == b"some test content"
+        assert await loc.file_exists()
+        assert await loc.file_len() == 17
+
+    asyncio.run(main())
+
+
+def test_fs_read_missing(tmp_path):
+    async def main():
+        loc = Location.parse(str(tmp_path / "missing"))
+        with pytest.raises(LocationError):
+            await loc.read()
+        assert not await loc.file_exists()
+
+    asyncio.run(main())
+
+
+def test_fs_write_subfile_and_delete(tmp_path):
+    async def main():
+        base = Location.parse(str(tmp_path))
+        hash_ = AnyHash.from_buf(b"shard bytes")
+        child = await base.write_subfile(str(hash_), b"shard bytes")
+        assert child.is_child_of(base)
+        assert await child.read() == b"shard bytes"
+        locs = await base.write_shard(hash_, b"shard bytes")
+        assert locs == [child]
+        await child.delete()
+        assert not await child.file_exists()
+
+    asyncio.run(main())
+
+
+def test_fs_range_reads(tmp_path):
+    path = tmp_path / "ranged"
+    path.write_bytes(bytes(range(100)))
+
+    async def main():
+        loc = Location.local(str(path), Range(10, 20, False))
+        assert await loc.read() == bytes(range(10, 30))
+        # extend_zeros pads reads past EOF (location.rs:127-129)
+        loc = Location.local(str(path), Range(90, 20, True))
+        data = await loc.read()
+        assert data == bytes(range(90, 100)) + b"\0" * 10
+        # open-ended
+        loc = Location.local(str(path), Range(95, None, False))
+        assert await loc.read() == bytes(range(95, 100))
+        # writes to ranged locations are rejected
+        with pytest.raises(WriteToRangeError):
+            await loc.write(b"x")
+
+    asyncio.run(main())
+
+
+def test_fs_conflict_policy(tmp_path):
+    path = tmp_path / "conflict"
+
+    async def main():
+        loc = Location.parse(str(path))
+        await loc.write(b"first")
+        ignore_cx = LocationContext(on_conflict=IGNORE)
+        await loc.write(b"second", ignore_cx)
+        assert await loc.read() == b"first"  # ignored
+        await loc.write(b"third")  # default overwrite
+        assert await loc.read() == b"third"
+
+    asyncio.run(main())
+
+
+def test_fs_streaming(tmp_path):
+    src = tmp_path / "src"
+    dst = tmp_path / "dst"
+    src.write_bytes(os.urandom(3 << 20))
+
+    async def main():
+        sloc = Location.parse(str(src))
+        dloc = Location.parse(str(dst))
+        reader = await sloc.reader()
+        n = await dloc.write_from_reader(reader)
+        assert n == 3 << 20
+        assert dst.read_bytes() == src.read_bytes()
+
+    asyncio.run(main())
+
+
+def test_http_full_cycle():
+    async def main():
+        node = await FakeHttpNode().start()
+        cx = LocationContext()
+        try:
+            base = Location.parse(node.url + "/data")
+            hash_ = AnyHash.from_buf(b"http shard")
+            child = await base.write_subfile(str(hash_), b"http shard", cx)
+            assert str(child) == f"{node.url}/data%2F{hash_}" or \
+                child.is_child_of(base)
+            assert await child.read(cx) == b"http shard"
+            assert await child.file_exists(cx)
+            assert await child.file_len(cx) == len(b"http shard")
+            # conflict ignore
+            icx = LocationContext(on_conflict=IGNORE)
+            icx._sessions = cx._sessions
+            await child.write(b"changed", icx)
+            assert await child.read(cx) == b"http shard"
+            # overwrite
+            await child.write(b"changed", cx)
+            assert await child.read(cx) == b"changed"
+            # range read
+            rloc = child.with_range(Range(2, 3, False))
+            assert await rloc.read(cx) == b"ang"
+            # delete
+            await child.delete(cx)
+            with pytest.raises(HttpStatusError):
+                await child.read(cx)
+            # streaming put
+            dloc = Location.parse(node.url + "/streamed")
+            n = await dloc.write_from_reader(
+                aio.BytesReader(b"x" * 100000), cx)
+            assert n == 100000
+            assert await dloc.read(cx) == b"x" * 100000
+        finally:
+            await cx.aclose()
+            await node.stop()
+
+    asyncio.run(main())
+
+
+def test_http_put_failure_raises():
+    """A failed PUT (e.g. disk full) must surface, never report success."""
+    async def main():
+        node = await FakeHttpNode().start()
+        cx = LocationContext()
+        try:
+            loc = Location.parse(node.url + "/fail/x")
+            with pytest.raises(HttpStatusError):
+                await loc.write(b"data", cx)
+            with pytest.raises(HttpStatusError):
+                await loc.write_from_reader(aio.BytesReader(b"data"), cx)
+        finally:
+            await cx.aclose()
+            await node.stop()
+
+    asyncio.run(main())
+
+
+def test_http_missing_404():
+    async def main():
+        node = await FakeHttpNode().start()
+        cx = LocationContext()
+        try:
+            loc = Location.parse(node.url + "/nope")
+            with pytest.raises(HttpStatusError):
+                await loc.read(cx)
+            assert not await loc.file_exists(cx)
+        finally:
+            await cx.aclose()
+            await node.stop()
+
+    asyncio.run(main())
