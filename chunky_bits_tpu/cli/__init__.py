@@ -1,0 +1,4 @@
+"""Coreutils-style CLI (the reference's src/bin/chunky-bits/)."""
+
+from chunky_bits_tpu.cli.cluster_location import ClusterLocation  # noqa: F401
+from chunky_bits_tpu.cli.config import Config  # noqa: F401

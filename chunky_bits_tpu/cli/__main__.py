@@ -1,0 +1,5 @@
+import sys
+
+from chunky_bits_tpu.cli.main import main
+
+sys.exit(main())

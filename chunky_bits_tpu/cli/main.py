@@ -1,0 +1,359 @@
+"""``chunky-bits`` CLI: coreutils-like commands for files and clusters.
+
+Mirrors src/bin/chunky-bits/main.rs: global overrides ``--config``,
+``--chunk-size``, ``--data-chunks``, ``--parity-chunks`` (:76-93) and the 14
+subcommands (:96-177): cat, config-info, cluster-info, cp, decode-shards,
+encode-shards, file-info, find-unused-hashes, get-hashes, http-gateway, ls,
+migrate, resilver, verify.
+
+Cluster locations are formatted ``cluster-name#path/to/file``; a location
+for the cluster definition may be used instead of a name
+(``./cluster.yaml#path``); ``@#location`` addresses a file reference;
+``-`` is stdio.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+import yaml
+
+from chunky_bits_tpu.cli.cluster_location import ClusterLocation
+from chunky_bits_tpu.cli.config import Config
+from chunky_bits_tpu.errors import ChunkyBitsError
+from chunky_bits_tpu.file import AnyHash, Location
+from chunky_bits_tpu.ops import get_coder
+from chunky_bits_tpu.utils import aio
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="chunky-bits",
+        description="An interface for Chunky Bits files and clusters "
+                    "(TPU-native implementation).",
+    )
+    parser.add_argument("--config", help="Location for the config file")
+    parser.add_argument("--chunk-size", type=int,
+                        help="Default chunk size (log2) for non-cluster "
+                             "destinations")
+    parser.add_argument("--data-chunks", type=int,
+                        help="Default data chunks for non-cluster "
+                             "destinations")
+    parser.add_argument("--parity-chunks", type=int,
+                        help="Default parity chunks for non-cluster "
+                             "destinations")
+    parser.add_argument("--backend",
+                        help="Erasure backend (numpy, native, jax)")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("cat", help="Concatenate files together")
+    p.add_argument("targets", nargs="+")
+
+    p = sub.add_parser("config-info",
+                       help="Show the parsed configuration definition")
+    p.add_argument("--json", action="store_true")
+
+    p = sub.add_parser("cluster-info",
+                       help="Show the parsed cluster definition")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("cluster")
+
+    p = sub.add_parser("cp", help="Copy file from source to destination")
+    p.add_argument("source")
+    p.add_argument("destination")
+
+    p = sub.add_parser("decode-shards",
+                       help="Reassemble data from d-of-n shard files")
+    p.add_argument("targets", nargs="+")
+
+    p = sub.add_parser("encode-shards",
+                       help="Split a source into d+p shard files")
+    p.add_argument("source")
+    p.add_argument("targets", nargs="+")
+
+    p = sub.add_parser("file-info", help="Show a file reference")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("source")
+
+    p = sub.add_parser("find-unused-hashes",
+                       help="Find all hashes that are not referenced")
+    p.add_argument("--batch-size", type=int, default=100000)
+    p.add_argument("-r", "--remove", action="store_true")
+    p.add_argument("source", nargs="+",
+                   help="cluster/file-ref locations that define liveness")
+    p.add_argument("hashes", nargs="*", default=[],
+                   help="local hash directories to scan (after --)")
+
+    p = sub.add_parser("get-hashes",
+                       help="Get all the known hashes for a location")
+    p.add_argument("-d", "--dedup", action="store_true",
+                   dest="deduplicate")
+    p.add_argument("-s", "--sort", action="store_true")
+    p.add_argument("target")
+
+    p = sub.add_parser("http-gateway",
+                       help="Provide a HTTP Gateway for a cluster")
+    p.add_argument("cluster")
+    p.add_argument("-l", "--listen-addr", default="127.0.0.1:8000")
+
+    p = sub.add_parser("ls", help="List the files in a cluster directory")
+    p.add_argument("-r", "--recursive", action="store_true")
+    p.add_argument("target")
+
+    p = sub.add_parser(
+        "migrate",
+        help="Reference the file in its existing location and add parity")
+    p.add_argument("source")
+    p.add_argument("destination")
+
+    p = sub.add_parser("resilver", help="Resilver a cluster file")
+    p.add_argument("target")
+
+    p = sub.add_parser("verify", help="Verify a cluster file")
+    p.add_argument("target")
+
+    return parser
+
+
+def _dump(obj, as_json: bool) -> None:
+    if as_json:
+        json.dump(obj, sys.stdout, indent=2)
+        print()
+    else:
+        yaml.safe_dump(obj, sys.stdout, sort_keys=False)
+
+
+def _shard_geometry(args, targets: list) -> tuple[int, int]:
+    """Infer (d, p) for the standalone shard codec (main.rs:521-559)."""
+    if args.parity_chunks is None:
+        raise ChunkyBitsError(
+            "Parity Chunk Count must be known to decode shards")
+    p = args.parity_chunks
+    if args.data_chunks is not None:
+        d = args.data_chunks
+        if len(targets) != d + p:
+            raise ChunkyBitsError(
+                f"Invalid targets: Expected {d + p} targets but got "
+                f"{len(targets)}")
+        return d, p
+    if len(targets) <= p:
+        raise ChunkyBitsError(
+            f"Invalid targets: Expected more than {p} targets but got "
+            f"{len(targets)}")
+    return len(targets) - p, p
+
+
+async def run(args) -> int:
+    if args.backend:
+        os.environ["CHUNKY_BITS_TPU_BACKEND"] = args.backend
+    config = await Config.load_or_default(
+        args.config, chunk_size=args.chunk_size,
+        data_chunks=args.data_chunks, parity_chunks=args.parity_chunks)
+
+    cmd = args.command
+    if cmd == "cat":
+        destination = ClusterLocation.parse("-")
+        for target in args.targets:
+            reader = await ClusterLocation.parse(target).get_reader(config)
+            await destination.write_from_reader(config, reader)
+    elif cmd == "config-info":
+        _dump(config.to_obj(), args.json)
+    elif cmd == "cluster-info":
+        cluster = await config.get_cluster(args.cluster)
+        _dump(cluster.to_obj(), args.json)
+    elif cmd == "cp":
+        source = ClusterLocation.parse(args.source)
+        destination = ClusterLocation.parse(args.destination)
+        reader = await source.get_reader(config)
+        await destination.write_from_reader(config, reader)
+    elif cmd == "decode-shards":
+        targets = [ClusterLocation.parse(t) for t in args.targets]
+        d, p = _shard_geometry(args, targets)
+        coder = get_coder(d, p)
+        shards = []
+        for target in targets:
+            try:
+                reader = await target.get_reader(config)
+                shards.append(await _read_all(reader))
+            except (ChunkyBitsError, OSError) as err:
+                print(f"Error {target}: {err}", file=sys.stderr)
+                shards.append(None)
+        import numpy as np
+
+        arrays = [np.frombuffer(s, dtype=np.uint8) if s is not None
+                  else None for s in shards]
+        arrays = coder.reconstruct_data(arrays)
+        out = sys.stdout.buffer
+        for arr in arrays[:d]:
+            if arr is not None:
+                out.write(bytes(arr))
+        out.flush()
+    elif cmd == "encode-shards":
+        targets = [ClusterLocation.parse(t) for t in args.targets]
+        d, p = _shard_geometry(args, targets)
+        coder = get_coder(d, p)
+        source = ClusterLocation.parse(args.source)
+        data_buf = await _read_all(await source.get_reader(config))
+        from chunky_bits_tpu.file.file_part import split_into_shards
+
+        shards, _len = split_into_shards(data_buf, len(data_buf), d)
+        import numpy as np
+
+        parity = coder.encode([np.frombuffer(s, dtype=np.uint8)
+                               for s in shards]) if p else []
+        payloads = [bytes(s) for s in shards] + [bytes(x) for x in parity]
+        for target, payload in zip(targets, payloads):
+            try:
+                await target.write_from_reader(
+                    config, aio.BytesReader(payload))
+            except (ChunkyBitsError, OSError) as err:
+                print(f"Error {target}: {err}", file=sys.stderr)
+    elif cmd == "file-info":
+        source = ClusterLocation.parse(args.source)
+        file_ref = await source.get_file_reference(
+            config,
+            await config.get_default_data_chunks(),
+            await config.get_default_parity_chunks(),
+            await config.get_default_chunk_size())
+        _dump(file_ref.to_obj(), args.json)
+    elif cmd == "find-unused-hashes":
+        await find_unused_hashes(config, args)
+    elif cmd == "get-hashes":
+        target = ClusterLocation.parse(args.target)
+        hashes = []
+        async for h in target.get_hashes_rec(config):
+            if args.sort or args.deduplicate:
+                hashes.append(h)
+            else:
+                print(h)
+        if args.sort:
+            for h in sorted(set(hashes), key=str):
+                print(h)
+        elif args.deduplicate:
+            for h in dict.fromkeys(hashes):
+                print(h)
+    elif cmd == "http-gateway":
+        from chunky_bits_tpu.gateway import serve
+
+        cluster = await config.get_cluster(args.cluster)
+        host, sep, port = args.listen_addr.rpartition(":")
+        if not sep or not port.isdigit():
+            raise ChunkyBitsError(
+                f"invalid --listen-addr {args.listen_addr!r} "
+                "(expected host:port)")
+        await serve(cluster, host or "127.0.0.1", int(port))
+    elif cmd == "ls":
+        target = ClusterLocation.parse(args.target)
+        if args.recursive:
+            async for entry in target.list_files_recursive(config):
+                print(entry)
+        else:
+            for entry in await target.list_files(config):
+                print(entry)
+    elif cmd == "migrate":
+        source = ClusterLocation.parse(args.source)
+        destination = ClusterLocation.parse(args.destination)
+        await source.migrate(config, destination)
+    elif cmd == "resilver":
+        target = ClusterLocation.parse(args.target)
+        report = await target.resilver(config)
+        print(report.display_full_report())
+    elif cmd == "verify":
+        target = ClusterLocation.parse(args.target)
+        report = await target.verify(config)
+        print(report.display_full_report())
+    return 0
+
+
+async def _read_all(reader: aio.AsyncByteReader) -> bytes:
+    chunks = []
+    while True:
+        data = await reader.read(1 << 20)
+        if not data:
+            break
+        chunks.append(data)
+    return b"".join(chunks)
+
+
+async def find_unused_hashes(config, args) -> None:
+    """GC: list hash files under local dirs, subtract hashes referenced by
+    the sources, print/remove the orphans; batched (main.rs:329-435)."""
+    sources = [ClusterLocation.parse(s) for s in args.source]
+    for s in sources:
+        if s.kind not in ("cluster", "file_ref"):
+            raise ChunkyBitsError(f"Unsupported source location: {s}")
+    hash_dirs = [ClusterLocation.parse(h) for h in args.hashes]
+    for h in hash_dirs:
+        if h.kind != "other" or not h.location.is_local():
+            raise ChunkyBitsError(f"Unsupported hashes location: {h}")
+
+    async def hash_files():
+        for hash_dir in hash_dirs:
+            async for entry in hash_dir.list_files_recursive(config):
+                if entry.is_file():
+                    yield entry.path
+
+    files_iter = hash_files()
+    done = False
+    while not done:
+        existing: dict[str, list[str]] = {}
+        while len(existing) < args.batch_size:
+            try:
+                path = await files_iter.__anext__()
+            except StopAsyncIteration:
+                done = True
+                break
+            name = os.path.basename(path)
+            try:
+                hash_ = AnyHash.parse(name)
+            except ChunkyBitsError:
+                print(f"Unknown hash: {name}", file=sys.stderr)
+                continue
+            existing.setdefault(str(hash_), []).append(path)
+        if not existing:
+            break
+        for source in sources:
+            async for hash_ in source.get_hashes_rec(config):
+                existing.pop(str(hash_), None)
+        for hash_str, paths in existing.items():
+            print(hash_str)
+            if args.remove:
+                for path in paths:
+                    print(f"Removing {path}", file=sys.stderr)
+                    await Location.local(path).delete()
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    # `find-unused-hashes SOURCES -- HASH_DIRS`: split at the separator
+    # ourselves (argparse cannot split two variadic positionals).
+    tail: list[str] = []
+    if "--" in argv:
+        idx = argv.index("--")
+        argv, tail = argv[:idx], argv[idx + 1:]
+    args = build_parser().parse_args(argv)
+    if tail:
+        if args.command != "find-unused-hashes":
+            print("unexpected arguments after --", file=sys.stderr)
+            return 2
+        args.hashes = tail
+    if args.command == "find-unused-hashes" and not args.hashes:
+        print("find-unused-hashes requires hash directories after --",
+              file=sys.stderr)
+        return 2
+    try:
+        return asyncio.run(run(args))
+    except ChunkyBitsError as err:
+        print(err, file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())

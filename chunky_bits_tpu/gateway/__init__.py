@@ -1,0 +1,3 @@
+"""Streaming HTTP object gateway (the reference's src/http.rs)."""
+
+from chunky_bits_tpu.gateway.http import make_app, parse_http_range, serve  # noqa: F401

@@ -1,0 +1,155 @@
+"""HTTP object gateway over one cluster.
+
+Mirrors src/http.rs: GET/HEAD stream a file out of the cluster with full
+single-range support (Range/Prefix/Suffix -> seek/take; 206 + Content-Range;
+416 on unsatisfiable; :27-95); Content-Length and Content-Type headers
+(:77-81); 404 on metadata miss (:86-89); PUT streams the body through
+``write_file`` with the default profile, capturing Content-Type (:97-118).
+
+Deviations, documented: the reference's ``bytes=a-b`` handler reads
+``b - a`` bytes (an off-by-one against RFC 9110 inclusive ranges,
+http.rs:40-42) and emits a Content-Range without the ``bytes `` unit; both
+are corrected here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from aiohttp import web
+
+from chunky_bits_tpu.cluster import Cluster
+from chunky_bits_tpu.errors import ChunkyBitsError, MetadataReadError
+from chunky_bits_tpu.file import FileReadBuilder
+
+
+class HttpRangeError(ValueError):
+    pass
+
+
+def parse_http_range(s: str):
+    """Parse a single ``bytes=`` range header (http.rs:151-220).
+    Returns ("range", start, end_inclusive) | ("prefix", start) |
+    ("suffix", length)."""
+    unit, sep, spec = s.partition("=")
+    if not sep:
+        raise HttpRangeError("invalid format")
+    if unit != "bytes":
+        raise HttpRangeError("unknown unit")
+    if "," in spec:
+        raise HttpRangeError("multi-range not supported")
+    start_s, sep, end_s = spec.partition("-")
+    if not sep:
+        raise HttpRangeError("invalid format")
+    try:
+        start = int(start_s) if start_s else None
+        end = int(end_s) if end_s else None
+    except ValueError as err:
+        raise HttpRangeError("invalid integer") from err
+    if start is not None and end is not None:
+        if start > end:
+            raise HttpRangeError("invalid length")
+        return ("range", start, end)
+    if start is not None:
+        return ("prefix", start)
+    if end is not None:
+        return ("suffix", end)
+    raise HttpRangeError("no range specified")
+
+
+def make_app(cluster: Cluster) -> web.Application:
+    cx = cluster.tunables.location_context()
+
+    async def handle_get(request: web.Request) -> web.StreamResponse:
+        path = request.match_info["path"]
+        try:
+            file_ref = await cluster.get_file_ref(path)
+        except MetadataReadError:
+            return web.Response(status=404)
+        except ChunkyBitsError:
+            return web.Response(status=500)
+        builder = FileReadBuilder(file_ref).location_context(cx)
+        status = 200
+        headers = {}
+        range_header = request.headers.get("Range")
+        parsed = None
+        if range_header is not None:
+            try:
+                parsed = parse_http_range(range_header)
+            except HttpRangeError:
+                # RFC 9110: an unparseable/unknown-unit/multi-range header
+                # is ignored, not rejected; 416 is only for unsatisfiable
+                # ranges.
+                parsed = None
+        if parsed is not None:
+            total = file_ref.len_bytes()
+            if parsed[0] == "range":
+                _, start, end = parsed
+                builder = builder.with_seek(start).with_take(end - start + 1)
+            elif parsed[0] == "prefix":
+                builder = builder.with_seek(parsed[1])
+            else:  # suffix
+                length = parsed[1]
+                if length > total:
+                    return web.Response(status=416)
+                builder = builder.with_seek(total - length).with_take(length)
+            if builder.len_bytes() == 0:
+                return web.Response(status=416)
+            seek = builder.seek
+            end_excl = seek + builder.len_bytes()
+            headers["Content-Range"] = \
+                f"bytes {seek}-{end_excl - 1}/{total}"
+            status = 206
+        headers["Content-Length"] = str(builder.len_bytes())
+        if file_ref.content_type:
+            headers["Content-Type"] = file_ref.content_type
+        resp = web.StreamResponse(status=status, headers=headers)
+        await resp.prepare(request)
+        if request.method == "HEAD":
+            return resp
+        async for chunk in builder.stream():
+            await resp.write(chunk)
+        await resp.write_eof()
+        return resp
+
+    async def handle_put(request: web.Request) -> web.Response:
+        path = request.match_info["path"]
+        profile = cluster.get_profile(None)
+        content_type: Optional[str] = request.headers.get("Content-Type")
+
+        class _BodyReader:
+            async def read(self, n: int = -1) -> bytes:
+                if n < 0:
+                    return await request.content.read()
+                return await request.content.read(n)
+
+        try:
+            await cluster.write_file(
+                path, _BodyReader(), profile, content_type)
+        except ChunkyBitsError:
+            return web.Response(status=500)
+        return web.Response(status=200)
+
+    app = web.Application()
+    app.router.add_get("/{path:.*}", handle_get)  # also serves HEAD
+    app.router.add_put("/{path:.*}", handle_put)
+    return app
+
+
+async def serve(cluster: Cluster, host: str = "127.0.0.1",
+                port: int = 8000) -> None:
+    """Bind and serve until cancelled (ctrl-c graceful shutdown,
+    main.rs:474-485)."""
+    runner = web.AppRunner(make_app(cluster))
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    print(f"listening on http://{host}:{port}")
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await runner.cleanup()

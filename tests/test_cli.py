@@ -1,0 +1,214 @@
+"""CLI end-to-end tests, driving ``python -m chunky_bits_tpu.cli`` as a
+subprocess — the analogue of the reference CI's encode-decode job
+(.github/workflows/compile.yml) plus coverage of the ClusterLocation
+grammar and the standalone shard codec."""
+
+import hashlib
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(*argv, check=True, **kwargs):
+    env = dict(os.environ)
+    env.setdefault("PYTHONPATH", REPO)
+    result = subprocess.run(
+        [sys.executable, "-m", "chunky_bits_tpu.cli", *argv],
+        capture_output=True, env=env, cwd=REPO, **kwargs)
+    if check and result.returncode != 0:
+        raise AssertionError(
+            f"cli failed ({result.returncode}): {result.stderr.decode()}")
+    return result
+
+
+@pytest.fixture
+def cluster_yaml(tmp_path):
+    dirs = []
+    for i in range(5):
+        d = tmp_path / f"disk{i}"
+        d.mkdir()
+        dirs.append(str(d))
+    meta = tmp_path / "metadata"
+    meta.mkdir()
+    path = tmp_path / "cluster.yaml"
+    path.write_text(yaml.safe_dump({
+        "destinations": [{"location": d} for d in dirs],
+        "metadata": {"type": "path", "format": "yaml", "path": str(meta)},
+        "profiles": {"default": {"data": 3, "parity": 2,
+                                 "chunk_size": 16}},
+    }))
+    return path
+
+
+def test_cluster_location_grammar():
+    from chunky_bits_tpu.cli.cluster_location import ClusterLocation
+
+    cases = {
+        "mycluster#path/to/file": ("cluster", "mycluster", None),
+        "mycluster[fast]#path": ("cluster", "mycluster", "fast"),
+        "./cluster.yaml#file": ("cluster", "./cluster.yaml", None),
+        "@#/tmp/ref.yaml": ("file_ref", None, None),
+        "/tmp/file": ("other", None, None),
+        "-": ("stdio", None, None),
+    }
+    for s, (kind, cluster, profile) in cases.items():
+        loc = ClusterLocation.parse(s)
+        assert loc.kind == kind, s
+        if cluster is not None:
+            assert loc.cluster == cluster
+        assert loc.profile == profile
+        assert str(loc) == s
+
+
+def test_cp_cat_roundtrip(cluster_yaml, tmp_path):
+    """50.25 MiB-style encode->decode, scaled down (256 KiB x 9 + tail)."""
+    payload = os.urandom(256 * 1024 * 9 + 77)
+    src = tmp_path / "input.bin"
+    src.write_bytes(payload)
+    run_cli("cp", str(src), f"{cluster_yaml}#files/input.bin")
+    out = run_cli("cat", f"{cluster_yaml}#files/input.bin")
+    assert hashlib.sha256(out.stdout).hexdigest() == \
+        hashlib.sha256(payload).hexdigest()
+    # read through the file-reference scheme too (cp @#ref out)
+    meta = yaml.safe_load(
+        (tmp_path / "metadata" / "files" / "input.bin").read_text())
+    assert meta["length"] == len(payload)
+    out = run_cli("cat", f"@#{tmp_path}/metadata/files/input.bin")
+    assert out.stdout == payload
+
+
+def test_cp_from_stdin(cluster_yaml):
+    payload = b"stdin payload" * 1000
+    run_cli("cp", "-", f"{cluster_yaml}#from-stdin", input=payload)
+    out = run_cli("cat", f"{cluster_yaml}#from-stdin")
+    assert out.stdout == payload
+
+
+def test_ls(cluster_yaml, tmp_path):
+    run_cli("cp", "-", f"{cluster_yaml}#a/b/file1", input=b"x")
+    run_cli("cp", "-", f"{cluster_yaml}#file2", input=b"y")
+    out = run_cli("ls", f"{cluster_yaml}#.")
+    listing = out.stdout.decode().splitlines()
+    assert "file2" in listing and "a" in listing
+    out = run_cli("ls", "-r", f"{cluster_yaml}#.")
+    listing = out.stdout.decode().splitlines()
+    assert "a/b/file1" in listing and "file2" in listing
+
+
+def test_verify_and_resilver_cli(cluster_yaml, tmp_path):
+    payload = os.urandom(200000)
+    run_cli("cp", "-", f"{cluster_yaml}#victim", input=payload)
+    meta = yaml.safe_load(
+        (tmp_path / "metadata" / "victim").read_text())
+    # delete one chunk file
+    victim_loc = meta["parts"][0]["data"][0]["locations"][0]
+    os.remove(victim_loc)
+    out = run_cli("verify", f"{cluster_yaml}#victim")
+    assert "Degraded" in out.stdout.decode()
+    out = run_cli("resilver", f"{cluster_yaml}#victim")
+    assert "Resilvered" in out.stdout.decode() or \
+        "Valid" in out.stdout.decode()
+    out = run_cli("verify", f"{cluster_yaml}#victim")
+    assert "file\tValid" in out.stdout.decode()
+
+
+def test_encode_decode_shards(tmp_path):
+    payload = os.urandom(10000)
+    src = tmp_path / "src.bin"
+    src.write_bytes(payload)
+    shard_paths = [str(tmp_path / f"shard{i}") for i in range(5)]
+    run_cli("--data-chunks", "3", "--parity-chunks", "2",
+            "encode-shards", str(src), *shard_paths)
+    # drop one data and one parity shard; decode from the rest
+    os.remove(shard_paths[0])
+    os.remove(shard_paths[4])
+    out = run_cli("--data-chunks", "3", "--parity-chunks", "2",
+                  "decode-shards", *shard_paths, check=True)
+    # decoded output is zero-padded to the stripe; trim to payload length
+    assert out.stdout[:len(payload)] == payload
+    assert len(out.stdout) >= len(payload)
+
+
+def test_file_info_and_get_hashes(cluster_yaml):
+    payload = os.urandom(70000)
+    run_cli("cp", "-", f"{cluster_yaml}#hashed", input=payload)
+    out = run_cli("file-info", f"{cluster_yaml}#hashed")
+    info = yaml.safe_load(out.stdout)
+    assert info["length"] == len(payload)
+    out = run_cli("get-hashes", f"{cluster_yaml}#hashed")
+    hashes = out.stdout.decode().split()
+    parts = info["parts"]
+    expected = sum(len(p["data"]) + len(p.get("parity", []))
+                   for p in parts)
+    assert len(hashes) == expected
+    assert all(h.startswith("sha256-") for h in hashes)
+    out_sorted = run_cli("get-hashes", "--sort", f"{cluster_yaml}#hashed")
+    assert out_sorted.stdout.decode().split() == \
+        sorted(set(hashes))
+
+
+def test_migrate(cluster_yaml, tmp_path):
+    """migrate references a file in place via range-sliced locations."""
+    payload = os.urandom(150000)
+    src = tmp_path / "existing.bin"
+    src.write_bytes(payload)
+    run_cli("migrate", str(src), f"{cluster_yaml}#migrated")
+    out = run_cli("cat", f"{cluster_yaml}#migrated")
+    assert out.stdout == payload
+    # the data was NOT copied: chunk locations are range views of src
+    meta = yaml.safe_load(
+        (tmp_path / "metadata" / "migrated").read_text())
+    first_loc = meta["parts"][0]["data"][0]["locations"][-1]
+    assert str(src) in first_loc and first_loc.startswith("(")
+
+
+def test_find_unused_hashes(cluster_yaml, tmp_path):
+    payload = os.urandom(100000)
+    run_cli("cp", "-", f"{cluster_yaml}#live", input=payload)
+    # drop an orphan chunk file into disk0
+    orphan_hash = "sha256-" + hashlib.sha256(b"orphan").hexdigest()
+    orphan_path = tmp_path / "disk0" / orphan_hash
+    orphan_path.write_bytes(b"orphan")
+    disks = [str(tmp_path / f"disk{i}") for i in range(5)]
+    out = run_cli("find-unused-hashes", f"{cluster_yaml}#.",
+                  "--", *disks)
+    assert orphan_hash in out.stdout.decode()
+    live_hashes = run_cli(
+        "get-hashes", f"{cluster_yaml}#live").stdout.decode().split()
+    assert all(h not in out.stdout.decode() for h in live_hashes)
+    # --remove deletes the orphan
+    run_cli("find-unused-hashes", "--remove", f"{cluster_yaml}#.",
+            "--", *disks)
+    assert not orphan_path.exists()
+    # live data still reads back
+    out = run_cli("cat", f"{cluster_yaml}#live")
+    assert out.stdout == payload
+
+
+def test_cluster_info_and_config_info(cluster_yaml):
+    out = run_cli("cluster-info", str(cluster_yaml))
+    obj = yaml.safe_load(out.stdout)
+    assert len(obj["destinations"]) == 5
+    out = run_cli("cluster-info", "--json", str(cluster_yaml))
+    import json
+
+    obj = json.loads(out.stdout)
+    assert obj["profiles"]["default"]["data_chunks"] == 3
+    out = run_cli("config-info")
+    obj = yaml.safe_load(out.stdout)
+    assert obj["default_destination"]["type"] == "void"
+
+
+def test_error_paths(cluster_yaml):
+    result = run_cli("cat", f"{cluster_yaml}#does-not-exist", check=False)
+    assert result.returncode != 0
+    result = run_cli("cat", "nonexistent-cluster#x", check=False)
+    assert result.returncode != 0
+    assert b"not defined" in result.stderr or b"Error" in result.stderr
+    result = run_cli("resilver", "/tmp/just-a-file", check=False)
+    assert result.returncode != 0
