@@ -1,0 +1,31 @@
+"""The core GF(2^8) bit-plane transform, shared by every JAX path.
+
+``apply_bitplane(m2, shards)`` computes
+``out[b, r, s] = pack((m2 @ unpack(shards)) mod 2)`` where ``m2`` is a
+0/1 bf16 matrix from ``gf256.expand_to_bit_matrix``.  Used by the
+single-device einsum path (ops/jax_backend.py), the mesh-sharded path
+(parallel/mesh.py) and the driver entry; the Pallas kernel
+(ops/pallas_kernels.py) is the fused equivalent of this exact function.
+"""
+
+from __future__ import annotations
+
+
+def apply_bitplane(m2, shards):
+    """m2: bf16 [r8, k8] of 0/1; shards: uint8 [B, k, S] -> uint8 [B, r, S].
+
+    Products are 0/1 and the contraction length is <= 2048, so bf16 inputs
+    with f32 accumulation are exact; the mod-2 keeps only the XOR parity.
+    """
+    import jax.numpy as jnp
+
+    b, k, s = shards.shape
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (shards[:, :, None, :] >> shifts[None, None, :, None]) & 1
+    bits = bits.reshape(b, k * 8, s).astype(jnp.bfloat16)
+    acc = jnp.einsum("rk,bks->brs", m2, bits,
+                     preferred_element_type=jnp.float32)
+    out_bits = acc.astype(jnp.int32) & 1
+    out_bits = out_bits.reshape(b, m2.shape[0] // 8, 8, s)
+    packed = jnp.sum(out_bits << shifts[None, None, :, None], axis=2)
+    return packed.astype(jnp.uint8)

@@ -59,24 +59,10 @@ def _jitted_apply():
     global _APPLY_FN
     if _APPLY_FN is not None:
         return _APPLY_FN
-    jax, jnp = _ensure_jax()
+    jax, _ = _ensure_jax()
+    from chunky_bits_tpu.ops.bitplane import apply_bitplane
 
-    def apply(m2, shards):
-        # m2: bf16 [r8, k8] of 0/1; shards: uint8 [B, k, S]
-        b, k, s = shards.shape
-        shifts = jnp.arange(8, dtype=jnp.uint8)
-        bits = (shards[:, :, None, :] >> shifts[None, None, :, None]) & 1
-        bits = bits.reshape(b, k * 8, s).astype(jnp.bfloat16)
-        acc = jnp.einsum(
-            "rk,bks->brs", m2, bits, preferred_element_type=jnp.float32
-        )
-        out_bits = acc.astype(jnp.int32) & 1
-        r8 = m2.shape[0]
-        out_bits = out_bits.reshape(b, r8 // 8, 8, s)
-        packed = jnp.sum(out_bits << shifts[None, None, :, None], axis=2)
-        return packed.astype(jnp.uint8)
-
-    _APPLY_FN = jax.jit(apply)
+    _APPLY_FN = jax.jit(apply_bitplane)
     return _APPLY_FN
 
 
@@ -93,9 +79,13 @@ class JaxBackend(ErasureBackend):
     max_cached_matrices = 256
 
     def __init__(self) -> None:
-        _ensure_jax()
+        jax, _ = _ensure_jax()
         self._m2_cache: OrderedDict[bytes, object] = OrderedDict()
         self._lock = threading.Lock()
+        # 128-aligned shard sizes on a TPU take the fused Pallas kernel
+        # (ops/pallas_kernels.py — a TPU-only Mosaic kernel); everything
+        # else, including GPU backends, takes the einsum path.
+        self._on_tpu = jax.default_backend() in ("tpu", "axon")
 
     def _bit_matrix(self, mat: np.ndarray):
         jax, jnp = _ensure_jax()
@@ -119,6 +109,11 @@ class JaxBackend(ErasureBackend):
         r = mat.shape[0]
         if r == 0 or b == 0:
             return np.zeros((b, r, s), dtype=np.uint8)
+        if self._on_tpu and s % 128 == 0 and s >= 1024:
+            try:
+                return self._apply_pallas_blocked(mat, shards)
+            except Exception:  # untileable shape or Mosaic lowering issue
+                pass  # einsum fallback below
         m2 = self._bit_matrix(mat)
         fn = _jitted_apply()
         # Block the batch axis so the 16x bit expansion fits device memory.
@@ -129,3 +124,21 @@ class JaxBackend(ErasureBackend):
             chunk = jnp.asarray(shards[lo:lo + block])
             outs.append(np.asarray(fn(m2, chunk)))
         return np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+
+    #: the fused kernel keeps bits in VMEM, so its device footprint is just
+    #: data + parity; a much larger per-dispatch budget applies.
+    max_pallas_block_bytes = 2 << 30
+
+    def _apply_pallas_blocked(self, mat: np.ndarray, shards) -> np.ndarray:
+        from chunky_bits_tpu.ops.pallas_kernels import apply_matrix_pallas
+
+        b, k, s = shards.shape
+        per_item = k * s * 2
+        block = max(1, self.max_pallas_block_bytes // max(per_item, 1))
+        if block >= b:
+            return np.asarray(apply_matrix_pallas(mat, shards))
+        outs = []
+        for lo in range(0, b, block):
+            outs.append(np.asarray(
+                apply_matrix_pallas(mat, shards[lo:lo + block])))
+        return np.concatenate(outs, axis=0)

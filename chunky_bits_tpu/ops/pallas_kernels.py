@@ -1,0 +1,140 @@
+"""Fused Pallas TPU kernel for the GF(2^8) bit-plane transform.
+
+The jnp einsum path (ops/jax_backend.py) materializes the bit expansion in
+HBM: for every data byte it writes + reads 16 bytes of bf16 bit-planes, so
+encode is HBM-bound at ~16x amplification.  This kernel fuses
+unpack -> MXU matmul -> mod-2 -> pack inside VMEM, so HBM traffic drops to
+read(data) + write(parity) — the roofline for this op.
+
+Layout trick: bit-rows are ordered bit-major (row ``k*K + j`` = bit k of
+shard j) so the unpack is 8 static sublane-slice stores and the pack is 8
+static sublane-slice reads — no in-register transpose.  The host-side
+matrix builder permutes the GF bit-matrix into this order.
+
+Kernel math (per grid cell, shapes static):
+    bits[K8, TS]  = unpack(data[K, TS])          (VPU shifts/ands)
+    acc [R8, TS]  = m2[R8, K8] @ bits            (MXU, bf16 -> f32 exact)
+    out [R, TS]   = pack(acc & 1)                (VPU shifts/ors)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from chunky_bits_tpu.ops import gf256
+
+# import jax lazily via function call to keep CLI startup light
+_jax = None
+
+
+def _jx():
+    global _jax
+    if _jax is None:
+        import jax
+
+        _jax = jax
+    return _jax
+
+
+def bit_matrix_bitmajor(mat: np.ndarray) -> np.ndarray:
+    """Expand GF matrix [R, K] to GF(2) matrix [R*8, K*8] with bit-major
+    row/col ordering: row ``b*R + i`` is bit b of output byte-row i, col
+    ``b*K + j`` is bit b of input byte-row j."""
+    r, k = mat.shape
+    std = gf256.expand_to_bit_matrix(mat)  # row i*8+b, col j*8+b
+    # new[b*r + i] = std[i*8 + b]; new[:, b*k + j] = std[:, j*8 + b]
+    row_src = np.array([i * 8 + b for b in range(8) for i in range(r)])
+    col_src = np.array([j * 8 + b for b in range(8) for j in range(k)])
+    return std[row_src][:, col_src]
+
+
+@functools.lru_cache(maxsize=256)
+def _device_matrix(mat_bytes: bytes, r: int, k: int):
+    """Bit-major device matrix, cached per GF matrix (mirrors
+    JaxBackend._bit_matrix so hot encode loops neither rebuild nor
+    re-upload the constant)."""
+    _jx()
+    import jax.numpy as jnp
+
+    mat = np.frombuffer(mat_bytes, dtype=np.uint8).reshape(r, k)
+    m2 = bit_matrix_bitmajor(mat).astype(np.float32)
+    return jnp.asarray(m2, dtype=jnp.bfloat16)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_kernel(r: int, k: int, tile_s: int, interpret: bool):
+    jax = _jx()
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r8, k8 = r * 8, k * 8
+
+    def kernel(m2_ref, data_ref, out_ref, bits_ref):
+        data = data_ref[0].astype(jnp.int32)  # [K, TS]
+        for b in range(8):
+            bits_ref[b * k:(b + 1) * k, :] = (
+                (data >> b) & 1
+            ).astype(jnp.bfloat16)
+        acc = jax.lax.dot_general(
+            m2_ref[...], bits_ref[...],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [R8, TS]
+        acc = acc.astype(jnp.int32) & 1
+        packed = acc[0:r, :]
+        for b in range(1, 8):
+            packed = packed | (acc[b * r:(b + 1) * r, :] << b)
+        out_ref[0] = packed.astype(jnp.uint8)
+
+    def call(m2, data):
+        batch, _k, s = data.shape
+        grid = (batch, s // tile_s)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((r8, k8), lambda b, j: (0, 0)),
+                pl.BlockSpec((1, k, tile_s), lambda b, j: (b, 0, j)),
+            ],
+            out_specs=pl.BlockSpec((1, r, tile_s), lambda b, j: (b, 0, j)),
+            out_shape=jax.ShapeDtypeStruct((batch, r, s), jnp.uint8),
+            scratch_shapes=[pltpu.VMEM((k8, tile_s), jnp.bfloat16)],
+            interpret=interpret,
+        )(m2, data)
+
+    return jax.jit(call)
+
+
+def _pick_tile(s: int) -> int:
+    """Largest power-of-two tile <= 16384 lanes dividing s (s must be a
+    multiple of 128 for the fast path)."""
+    tile = 16384
+    while tile > 128 and s % tile != 0:
+        tile //= 2
+    return tile if s % tile == 0 else 0
+
+
+def apply_matrix_pallas(mat: np.ndarray, shards, *, interpret: bool = False):
+    """Device-side bit-plane transform via the fused kernel.
+
+    ``mat`` is the GF(2^8) matrix [R, K]; ``shards`` is a jax or numpy
+    uint8 array [B, K, S] with S a multiple of 128.  Returns a jax uint8
+    array [B, R, S].  Raises ValueError when shapes don't fit the fast
+    path (caller falls back to the einsum path).
+    """
+    jax = _jx()
+    import jax.numpy as jnp
+
+    r, k = mat.shape
+    b, k2, s = shards.shape
+    assert k2 == k
+    tile = _pick_tile(s)
+    if tile == 0 or r == 0:
+        raise ValueError(f"shard size {s} not tileable for pallas path")
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    m2 = _device_matrix(mat.tobytes(), r, k)
+    fn = _build_kernel(r, k, tile, interpret)
+    return fn(m2, jnp.asarray(shards))

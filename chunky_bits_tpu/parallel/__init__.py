@@ -1,0 +1,7 @@
+"""Multi-chip scaling: mesh-sharded erasure transforms."""
+
+from chunky_bits_tpu.parallel.mesh import (  # noqa: F401
+    encode_step_sharded,
+    make_mesh,
+    sharded_apply,
+)

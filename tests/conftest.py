@@ -7,12 +7,28 @@ imported anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The axon sitecustomize registers the tunneled-TPU PJRT plugin whenever
+# PALLAS_AXON_POOL_IPS is set and pins JAX_PLATFORMS=axon; drop both so the
+# suite runs on the virtual 8-device CPU backend.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon sitecustomize imports jax at interpreter startup (before this
+# file runs), so the env vars above are read too late; force the settings
+# through the live config instead.  Safe as long as no backend has been
+# initialized yet (sitecustomize only registers the plugin).  jax itself is
+# an optional dependency — without it the pure-host tests still run.
+try:
+    import jax  # noqa: E402
+except ImportError:
+    pass
+else:
+    jax.config.update("jax_platforms", "cpu")
 
 import sys  # noqa: E402
 

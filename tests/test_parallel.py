@@ -1,0 +1,93 @@
+"""Multi-device mesh tests: dp/sp-sharded erasure transforms on the
+virtual 8-device CPU mesh, byte-identical to the numpy oracle."""
+
+import numpy as np
+import pytest
+
+from chunky_bits_tpu.ops import matrix
+from chunky_bits_tpu.ops.backend import ErasureCoder, NumpyBackend
+
+
+@pytest.fixture(scope="module")
+def eight_devices():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return jax.devices()
+
+
+@pytest.mark.parametrize("dp,sp", [(8, 1), (4, 2), (2, 4), (1, 8)])
+def test_sharded_apply_identity(eight_devices, dp, sp):
+    from chunky_bits_tpu.parallel import make_mesh, sharded_apply
+
+    d, p = 10, 4
+    enc = matrix.build_encode_matrix(d, p)
+    rng = np.random.default_rng(dp * 10 + sp)
+    data = rng.integers(0, 256, (dp * 2, d, 128 * sp), dtype=np.uint8)
+    mesh = make_mesh(8, dp=dp, sp=sp)
+    got = np.asarray(sharded_apply(mesh, enc[d:], data))
+    want = ErasureCoder(d, p, NumpyBackend()).encode_batch(data)
+    assert np.array_equal(got, want)
+
+
+def test_encode_step_with_collective(eight_devices):
+    from chunky_bits_tpu.parallel import encode_step_sharded, make_mesh
+
+    d, p = 3, 2
+    enc = matrix.build_encode_matrix(d, p)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (8, d, 512), dtype=np.uint8)
+    mesh = make_mesh(8, dp=4, sp=2)
+    parity, checksum = encode_step_sharded(mesh, enc, data)
+    want = ErasureCoder(d, p, NumpyBackend()).encode_batch(data)
+    assert np.array_equal(np.asarray(parity), want)
+    assert int(checksum) == int(want.astype(np.uint64).sum() % (1 << 32))
+
+
+def test_sharded_decode(eight_devices):
+    """Reconstruction rows through the sharded path."""
+    from chunky_bits_tpu.parallel import make_mesh, sharded_apply
+
+    d, p = 10, 4
+    coder = ErasureCoder(d, p, NumpyBackend())
+    enc = coder.encode_matrix
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, (8, d, 256), dtype=np.uint8)
+    parity = coder.encode_batch(data)
+    full = np.concatenate([data, parity], axis=1)
+    present = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]  # shard 0 and 11-13 lost
+    wanted = [0]
+    dec = matrix.decode_matrix(enc, present, wanted)
+    mesh = make_mesh(8, dp=8, sp=1)
+    picked = full[:, np.array(present[:d]), :]
+    got = np.asarray(sharded_apply(mesh, dec, picked))
+    assert np.array_equal(got[:, 0, :], data[:, 0, :])
+
+
+def test_graft_entry():
+    """The driver's entry points must keep working."""
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__
+    import jax
+
+    fn, args = __graft_entry__.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[1] == 4
+    __graft_entry__.dryrun_multichip(len(jax.devices()))
+
+
+def test_pallas_kernel_interpret_identity():
+    """The fused pallas kernel, in interpret mode on CPU, must match the
+    oracle byte-for-byte (the TPU path runs the same kernel compiled)."""
+    from chunky_bits_tpu.ops.pallas_kernels import apply_matrix_pallas
+
+    d, p = 10, 4
+    enc = matrix.build_encode_matrix(d, p)
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, (2, d, 256), dtype=np.uint8)
+    got = np.asarray(apply_matrix_pallas(enc[d:], data, interpret=True))
+    want = ErasureCoder(d, p, NumpyBackend()).encode_batch(data)
+    assert np.array_equal(got, want)
