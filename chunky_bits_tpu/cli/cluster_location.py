@@ -120,13 +120,12 @@ class ClusterLocation:
     async def get_reader(self, config) -> aio.AsyncByteReader:
         if self.kind in ("cluster", "file_ref"):
             file_ref = await self._load_file_ref(config)
-            cx = None
+            builder = FileReadBuilder(file_ref)
             if self.kind == "cluster":
                 cluster = await config.get_cluster(self.cluster)
-                cx = cluster.tunables.location_context()
-            builder = FileReadBuilder(file_ref)
-            if cx is not None:
-                builder = builder.location_context(cx)
+                builder = builder.location_context(
+                    cluster.tunables.location_context()
+                ).with_backend(cluster.tunables.backend)
             return builder.reader()
         if self.kind == "other":
             return await self.location.reader()
@@ -251,7 +250,8 @@ class ClusterLocation:
             cluster, profile = await self.get_cluster_with_profile(config)
             destination = cluster.get_destination(profile)
             file_ref = await cluster.get_file_ref(self.path)
-            report = await file_ref.resilver(destination)
+            report = await file_ref.resilver(
+                destination, backend=cluster.tunables.backend)
             await cluster.write_file_ref(self.path, file_ref)
             return report
         if self.kind == "file_ref":

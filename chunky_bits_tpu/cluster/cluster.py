@@ -108,6 +108,9 @@ class Cluster:
         return reporter, Destination(self.destinations, profile, cx)
 
     def get_file_writer(self, profile: ClusterProfile) -> FileWriteBuilder:
+        # A device backend amortizes dispatch overhead by staging several
+        # parts into one batched encode (writer.py batch staging).
+        batch_parts = 8 if self.tunables.backend == "jax" else 1
         return (
             FileWriteBuilder()
             .with_destination(self.get_destination(profile))
@@ -116,6 +119,7 @@ class Cluster:
             # deliberate fix of the reference's missing parity setter
             .with_parity_chunks(profile.get_parity_chunks())
             .with_backend(self.tunables.backend)
+            .with_batch_parts(batch_parts)
         )
 
     async def write_file_ref(self, path: str,
@@ -156,8 +160,8 @@ class Cluster:
 
     async def read_file(self, path: str) -> aio.AsyncByteReader:
         file_ref = await self.get_file_ref(path)
-        return file_ref.read_builder(
-            self.tunables.location_context()).reader()
+        builder = file_ref.read_builder(self.tunables.location_context())
+        return builder.with_backend(self.tunables.backend).reader()
 
     async def list_files(self, path: str = ".") -> list[FileOrDirectory]:
         return await self.metadata.list(path)

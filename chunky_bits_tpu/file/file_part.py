@@ -121,7 +121,8 @@ class FilePart:
     # ---- read + decode (src/file/file_part.rs:73-135) ----
 
     async def read(self, cx: Optional[LocationContext] = None,
-                   coder: Optional[ErasureCoder] = None) -> bytes:
+                   coder: Optional[ErasureCoder] = None,
+                   backend: Optional[str] = None) -> bytes:
         """Scattered read: d workers randomly grab chunks from the shared
         d+p pool, falling through each chunk's locations; RS-reconstruct if
         any data chunk is missing.  Returns d*chunksize bytes (padding
@@ -152,12 +153,16 @@ class FilePart:
             if item is not None:
                 slots[item[0]] = item[1]
         if not all(slots[i] is not None for i in range(d)):
-            coder = coder or get_coder(d, p)
             present = sum(1 for s in slots if s is not None)
             if present < d:
                 raise NotEnoughChunks(
                     f"only {present} of {d}+{p} chunks readable"
                 )
+            if coder is None:
+                # Resolved lazily and off-loop: constructing a device
+                # backend (jax init) can take seconds and must neither
+                # block the event loop nor run on healthy reads.
+                coder = await asyncio.to_thread(get_coder, d, p, backend)
             arrays: list[Optional[np.ndarray]] = [
                 np.frombuffer(s, dtype=np.uint8) if s is not None else None
                 for s in slots
@@ -256,7 +261,8 @@ class FilePart:
 
     async def resilver(self, destination,
                        cx: Optional[LocationContext] = None,
-                       coder: Optional[ErasureCoder] = None
+                       coder: Optional[ErasureCoder] = None,
+                       backend: Optional[str] = None
                        ) -> "ResilverPartReport":
         # Deviation from the reference: repair writes always overwrite.
         # Under the default `on_conflict: ignore` tunable the reference's
@@ -299,7 +305,8 @@ class FilePart:
         if not all(chunk_status):
             # Reconstruct every missing chunk (data and parity).
             try:
-                coder = coder or get_coder(d, p)
+                if coder is None:
+                    coder = await asyncio.to_thread(get_coder, d, p, backend)
                 arrays: list[Optional[np.ndarray]] = [
                     np.frombuffer(b, dtype=np.uint8) if b is not None else None
                     for b in data_bufs

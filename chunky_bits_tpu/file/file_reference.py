@@ -96,13 +96,14 @@ class FileReference:
         return VerifyFileReport(list(reports))
 
     async def resilver(self, destination,
-                       cx: Optional[LocationContext] = None
+                       cx: Optional[LocationContext] = None,
+                       backend: Optional[str] = None
                        ) -> "ResilverFileReport":
         sem = asyncio.Semaphore(RESILVER_CONCURRENCY)
 
         async def one(part: FilePart) -> ResilverPartReport:
             async with sem:
-                return await part.resilver(destination, cx)
+                return await part.resilver(destination, cx, backend=backend)
 
         reports = await asyncio.gather(*[one(p) for p in self.parts])
         return ResilverFileReport(list(reports))

@@ -29,6 +29,10 @@ class FileReadBuilder:
     cx: LocationContext = field(default_factory=default_context)
     seek: int = 0
     take: int = 0
+    backend: Optional[str] = None  # erasure backend for reconstruction
+
+    def with_backend(self, backend: Optional[str]) -> "FileReadBuilder":
+        return replace(self, backend=backend)
 
     def with_seek(self, seek: int) -> "FileReadBuilder":
         return replace(self, seek=seek)
@@ -98,7 +102,9 @@ class FileReadBuilder:
                 await asyncio.gather(*tasks, return_exceptions=True)
 
     async def _read_part(self, part: FilePart, skip: int) -> bytes:
-        data = await part.read(self.cx)
+        # backend resolution happens lazily inside part.read, only when
+        # reconstruction is actually needed
+        data = await part.read(self.cx, backend=self.backend)
         if len(data) > skip:
             return data[skip:] if skip else data
         return b""

@@ -98,7 +98,13 @@ def get_backend(name: Optional[str] = None) -> ErasureBackend:
     elif name == "jax":
         from chunky_bits_tpu.ops.jax_backend import JaxBackend
 
-        backend = JaxBackend()
+        try:
+            backend = JaxBackend()
+        except ErasureError:
+            raise
+        except Exception as err:  # e.g. no usable jax device/platform
+            raise ErasureError(
+                f"jax erasure backend unavailable: {err}") from err
     elif name == "auto":
         try:
             from chunky_bits_tpu.ops.cpu_backend import NativeBackend

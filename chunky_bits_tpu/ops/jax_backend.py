@@ -112,8 +112,17 @@ class JaxBackend(ErasureBackend):
         if self._on_tpu and s % 128 == 0 and s >= 1024:
             try:
                 return self._apply_pallas_blocked(mat, shards)
-            except Exception:  # untileable shape or Mosaic lowering issue
-                pass  # einsum fallback below
+            except ValueError:
+                pass  # untileable shape: einsum fallback for this call
+            except Exception as err:
+                # An unexpected Mosaic/compile failure would otherwise be
+                # re-attempted (and re-compiled, seconds each) on every
+                # dispatch; disable the fast path once and say so.
+                import warnings
+
+                warnings.warn(
+                    f"pallas erasure kernel disabled after failure: {err}")
+                self._on_tpu = False
         m2 = self._bit_matrix(mat)
         fn = _jitted_apply()
         # Block the batch axis so the 16x bit expansion fits device memory.

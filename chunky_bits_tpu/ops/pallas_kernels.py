@@ -51,16 +51,13 @@ def bit_matrix_bitmajor(mat: np.ndarray) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=256)
-def _device_matrix(mat_bytes: bytes, r: int, k: int):
-    """Bit-major device matrix, cached per GF matrix (mirrors
-    JaxBackend._bit_matrix so hot encode loops neither rebuild nor
-    re-upload the constant)."""
-    _jx()
-    import jax.numpy as jnp
-
+def _host_matrix(mat_bytes: bytes, r: int, k: int) -> np.ndarray:
+    """Bit-major host matrix, cached per GF matrix so hot encode loops
+    don't rebuild the expansion.  Only the (tiny, ~KBs) host->device copy
+    happens per eager call — caching the *device* array here would leak
+    tracers whenever the first call happens under a jit trace."""
     mat = np.frombuffer(mat_bytes, dtype=np.uint8).reshape(r, k)
-    m2 = bit_matrix_bitmajor(mat).astype(np.float32)
-    return jnp.asarray(m2, dtype=jnp.bfloat16)
+    return bit_matrix_bitmajor(mat).astype(np.float32)
 
 
 @functools.lru_cache(maxsize=32)
@@ -135,6 +132,6 @@ def apply_matrix_pallas(mat: np.ndarray, shards, *, interpret: bool = False):
     if tile == 0 or r == 0:
         raise ValueError(f"shard size {s} not tileable for pallas path")
     mat = np.ascontiguousarray(mat, dtype=np.uint8)
-    m2 = _device_matrix(mat.tobytes(), r, k)
+    m2 = jnp.asarray(_host_matrix(mat.tobytes(), r, k), dtype=jnp.bfloat16)
     fn = _build_kernel(r, k, tile, interpret)
     return fn(m2, jnp.asarray(shards))

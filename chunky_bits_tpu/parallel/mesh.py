@@ -20,6 +20,7 @@ scrub/verify aggregation); shards ride ICI via the mesh, never DCN.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import numpy as np
@@ -52,6 +53,37 @@ def make_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None,
 from chunky_bits_tpu.ops.bitplane import apply_bitplane as _apply_local
 
 
+@functools.lru_cache(maxsize=16)
+def _host_bit_matrix(mat_bytes: bytes, r: int, k: int) -> np.ndarray:
+    # host-side cache only: caching device arrays would leak tracers if
+    # the first call happened under a jit trace
+    mat = np.frombuffer(mat_bytes, dtype=np.uint8).reshape(r, k)
+    return gf256.expand_to_bit_matrix(mat).astype(np.float32)
+
+
+def _device_bit_matrix(mat_bytes: bytes, r: int, k: int):
+    import jax.numpy as jnp
+
+    return jnp.asarray(_host_bit_matrix(mat_bytes, r, k),
+                       dtype=jnp.bfloat16)
+
+
+@functools.lru_cache(maxsize=16)
+def _sharded_apply_fn(mesh):
+    """Jitted shard_mapped transform, cached per mesh so repeated calls
+    reuse the XLA executable instead of retracing."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    return jax.jit(shard_map(
+        _apply_local,
+        mesh=mesh,
+        in_specs=(P(None, None), P("dp", None, "sp")),
+        out_specs=P("dp", None, "sp"),
+    ))
+
+
 def sharded_apply(mesh, mat: np.ndarray, shards):
     """out[B, R, S] = mat ⊗ shards with B split over 'dp' and S over 'sp'.
 
@@ -59,21 +91,32 @@ def sharded_apply(mesh, mat: np.ndarray, shards):
     shardings are embarrassingly parallel — XLA inserts only the final
     all-gather to deliver the replicated-out result.
     """
+    import jax.numpy as jnp
+
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    m2 = _device_bit_matrix(mat.tobytes(), *mat.shape)
+    return _sharded_apply_fn(mesh)(m2, jnp.asarray(shards))
+
+
+@functools.lru_cache(maxsize=16)
+def _encode_step_fn(mesh):
     import jax
     import jax.numpy as jnp
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
-    m2 = jnp.asarray(gf256.expand_to_bit_matrix(mat).astype(np.float32),
-                     dtype=jnp.bfloat16)
+    def step(m2, shards):
+        parity = _apply_local(m2, shards)
+        local_sum = parity.astype(jnp.uint32).sum()
+        checksum = jax.lax.psum(jax.lax.psum(local_sum, "dp"), "sp")
+        return parity, checksum
 
-    fn = shard_map(
-        _apply_local,
+    return jax.jit(shard_map(
+        step,
         mesh=mesh,
         in_specs=(P(None, None), P("dp", None, "sp")),
-        out_specs=P("dp", None, "sp"),
-    )
-    return jax.jit(fn)(m2, jnp.asarray(shards))
+        out_specs=(P("dp", None, "sp"), P()),
+    ))
 
 
 def encode_step_sharded(mesh, encode_matrix: np.ndarray, data):
@@ -82,27 +125,9 @@ def encode_step_sharded(mesh, encode_matrix: np.ndarray, data):
 
     ``data`` is uint8 [B, d, S]; returns (parity [B, p, S], checksum).
     """
-    import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as P
 
     d = encode_matrix.shape[1]
-    parity_rows = encode_matrix[d:]
-    m2 = jnp.asarray(
-        gf256.expand_to_bit_matrix(parity_rows).astype(np.float32),
-        dtype=jnp.bfloat16)
-
-    def step(m2, shards):
-        parity = _apply_local(m2, shards)
-        local_sum = parity.astype(jnp.uint32).sum()
-        checksum = jax.lax.psum(jax.lax.psum(local_sum, "dp"), "sp")
-        return parity, checksum
-
-    fn = shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(P(None, None), P("dp", None, "sp")),
-        out_specs=(P("dp", None, "sp"), P()),
-    )
-    return jax.jit(fn)(m2, jnp.asarray(data))
+    parity_rows = np.ascontiguousarray(encode_matrix[d:], dtype=np.uint8)
+    m2 = _device_bit_matrix(parity_rows.tobytes(), *parity_rows.shape)
+    return _encode_step_fn(mesh)(m2, jnp.asarray(data))

@@ -1,0 +1,66 @@
+#!/usr/bin/env python3
+"""Read-only chunky-bits file-reference decoder (pyyaml is the only dep).
+
+Interop role (cf. the reference repo's python/ decoder): given a
+file-reference YAML/JSON document, stream the file it describes to stdout
+by concatenating the *data* chunks in order and truncating to the recorded
+length.  Only the first location of each chunk is consulted and there is no
+erasure reconstruction — degraded files need the full CLI
+(``chunky-bits cat @#<ref>``).  Works on references written by this
+framework or by the original Rust implementation.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import sys
+import urllib.request
+
+import yaml
+
+
+def fetch(location: str) -> bytes:
+    if "://" in location.split("/", 1)[0] or location.startswith(
+            ("http://", "https://")):
+        with urllib.request.urlopen(location) as resp:
+            return resp.read()
+    with open(location, "rb") as f:
+        return f.read()
+
+
+def decode(ref_path: str, out) -> int:
+    with open(ref_path) as f:
+        ref = yaml.safe_load(f)
+
+    remaining = ref.get("length")
+    status = 0
+    for part in ref.get("parts", []):
+        for chunk in part.get("data", []):
+            locations = chunk.get("locations") or []
+            if not locations:
+                print(f"chunk {chunk.get('sha256')} has no locations",
+                      file=sys.stderr)
+                return 1
+            payload = fetch(locations[0])
+            want = chunk.get("sha256")
+            got = hashlib.sha256(payload).hexdigest()
+            if want != got:
+                print(f"hash mismatch at {locations[0]}: {want} != {got}",
+                      file=sys.stderr)
+                status = 1
+            if remaining is not None:
+                payload = payload[:remaining]
+                remaining -= len(payload)
+            out.write(payload)
+    return status
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print("usage: chunky-bits.py <file-reference>", file=sys.stderr)
+        return 2
+    return decode(sys.argv[1], sys.stdout.buffer)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,101 @@
+"""End-to-end cluster lifecycle with the jax erasure backend selected via
+cluster.yaml tunables — the north-star configuration: same object store,
+compute plane on the accelerator (here the CPU jax backend; identical code
+path on TPU)."""
+
+import asyncio
+import os
+import random
+
+import numpy as np
+import pytest
+
+from chunky_bits_tpu.cluster import Cluster
+from chunky_bits_tpu.file import FileIntegrity
+from chunky_bits_tpu.ops import matrix
+from chunky_bits_tpu.ops.backend import ErasureCoder, NumpyBackend
+from chunky_bits_tpu.utils import aio
+
+
+def make_jax_cluster(tmp_path, d=4, p=2) -> Cluster:
+    dirs = []
+    for i in range(d + p + 1):
+        dd = tmp_path / f"disk{i}"
+        dd.mkdir()
+        dirs.append(str(dd))
+    meta = tmp_path / "meta"
+    meta.mkdir()
+    return Cluster.from_obj({
+        "destinations": [{"location": x} for x in dirs],
+        "metadata": {"type": "path", "format": "yaml", "path": str(meta)},
+        "tunables": {"backend": "jax"},
+        "profiles": {"default": {"data": d, "parity": p,
+                                 "chunk_size": 14}},
+    })
+
+
+def test_jax_backend_cluster_lifecycle(tmp_path):
+    cluster = make_jax_cluster(tmp_path)
+    assert cluster.tunables.backend == "jax"
+    rng = random.Random(3)
+    payload = bytes(rng.getrandbits(8) for _ in range(300000))
+
+    async def main():
+        profile = cluster.get_profile()
+        await cluster.write_file("f", aio.BytesReader(payload), profile)
+        # writer batching kicked in for the device backend
+        writer = cluster.get_file_writer(profile)
+        assert writer.batch_parts == 8
+
+        ref = await cluster.get_file_ref("f")
+        # shards on disk are byte-identical to the numpy oracle: re-derive
+        # parity from the stored data chunks and compare hashes
+        part = ref.parts[0]
+        data_rows = [np.frombuffer(open(c.locations[0].target, "rb").read(),
+                                   dtype=np.uint8) for c in part.data]
+        oracle = ErasureCoder(len(part.data), len(part.parity),
+                              NumpyBackend())
+        parity_rows = oracle.encode_batch(np.stack(data_rows)[None])[0]
+        from chunky_bits_tpu.file.hashing import AnyHash
+
+        for row, chunk in zip(parity_rows, part.parity):
+            assert AnyHash.from_buf(bytes(row)) == chunk.hash
+
+        # degraded read + resilver through the jax reconstruct path
+        os.remove(part.data[0].locations[0].target)
+        os.remove(part.data[1].locations[0].target)
+        reader = await cluster.read_file("f")
+        got = []
+        while True:
+            b = await reader.read(1 << 16)
+            if not b:
+                break
+            got.append(b)
+        assert b"".join(got) == payload
+
+        report = await ref.resilver(
+            cluster.get_destination(profile), backend="jax")
+        assert report.integrity() == FileIntegrity.RESILVERED
+        verify = await ref.verify()
+        assert verify.integrity() == FileIntegrity.VALID
+
+    asyncio.run(main())
+
+
+def test_wide_stripe_sharded():
+    """BASELINE.md config 5: wide stripe d=20 p=6 across the 8-device
+    mesh."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from chunky_bits_tpu.parallel import make_mesh, sharded_apply
+
+    d, p = 20, 6
+    enc = matrix.build_encode_matrix(d, p)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (8, d, 512), dtype=np.uint8)
+    mesh = make_mesh(8, dp=4, sp=2)
+    got = np.asarray(sharded_apply(mesh, enc[d:], data))
+    want = ErasureCoder(d, p, NumpyBackend()).encode_batch(data)
+    assert np.array_equal(got, want)
