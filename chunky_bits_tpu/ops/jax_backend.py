@@ -112,8 +112,6 @@ class JaxBackend(ErasureBackend):
         if self._on_tpu and s % 128 == 0 and s >= 1024:
             try:
                 return self._apply_pallas_blocked(mat, shards)
-            except ValueError:
-                pass  # untileable shape: einsum fallback for this call
             except Exception as err:
                 # An unexpected Mosaic/compile failure would otherwise be
                 # re-attempted (and re-compiled, seconds each) on every

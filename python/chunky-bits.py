@@ -20,10 +20,11 @@ import yaml
 
 
 def fetch(location: str) -> bytes:
-    if "://" in location.split("/", 1)[0] or location.startswith(
-            ("http://", "https://")):
+    if location.startswith(("http://", "https://")):
         with urllib.request.urlopen(location) as resp:
             return resp.read()
+    if "://" in location:
+        raise ValueError(f"unsupported location scheme: {location}")
     with open(location, "rb") as f:
         return f.read()
 
