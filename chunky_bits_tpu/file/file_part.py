@@ -28,7 +28,7 @@ from chunky_bits_tpu.errors import (
     ShardError,
 )
 from chunky_bits_tpu.file.chunk import Chunk
-from chunky_bits_tpu.file.hashing import AnyHash, hash_buf_async
+from chunky_bits_tpu.file.hashing import AnyHash, Sha256Hash, hash_buf_async
 from chunky_bits_tpu.file.location import Location, LocationContext, \
     default_context
 from chunky_bits_tpu.ops import ErasureCoder, get_coder
@@ -197,22 +197,37 @@ class FilePart:
         destination,
         data_buf,
         length: int,
-        precomputed: Optional[tuple[list, list, int]] = None,
+        precomputed: Optional[tuple] = None,
     ) -> "FilePart":
         """Encode one part and write all d+p shards concurrently,
-        failing fast on the first shard error."""
+        failing fast on the first shard error.
+
+        ``precomputed`` is ``(shards, parity, buf_length)`` or
+        ``(shards, parity, buf_length, digests)`` from a staging layer;
+        ``digests`` (32-byte sha256 per shard, data then parity — the
+        fused encode+hash output) skips re-hashing here."""
+        digests: Optional[list] = None
         if precomputed is not None:
-            shards, parity, buf_length = precomputed
+            shards, parity, buf_length = precomputed[:3]
+            if len(precomputed) > 3:
+                digests = precomputed[3]
         else:
             shards, parity, buf_length = await asyncio.to_thread(
                 FilePart.encode_shards, coder, data_buf, length
             )
         d, p = coder.data, coder.parity
+        if digests is not None and len(digests) != d + p:
+            raise FileWriteError(
+                f"staging layer produced {len(digests)} digests "
+                f"for {d}+{p} shards")
         writers = destination.get_writers(d + p)
 
-        async def hash_and_write(payload, writer) -> Chunk:
+        async def hash_and_write(payload, writer, digest) -> Chunk:
             payload = bytes(payload)
-            hash_ = await hash_buf_async(payload)
+            if digest is not None:
+                hash_ = AnyHash.sha256(Sha256Hash(digest))
+            else:
+                hash_ = await hash_buf_async(payload)
             try:
                 locations = await writer.write_shard(hash_, payload)
             except ShardError as err:
@@ -220,8 +235,10 @@ class FilePart:
             return Chunk(hash=hash_, locations=locations)
 
         payloads = list(shards) + list(parity)
-        tasks = [asyncio.ensure_future(hash_and_write(pl, w))
-                 for pl, w in zip(payloads, writers)]
+        pre_digests = digests if digests is not None \
+            else [None] * len(payloads)
+        tasks = [asyncio.ensure_future(hash_and_write(pl, w, dg))
+                 for pl, w, dg in zip(payloads, writers, pre_digests)]
         try:
             chunks = await asyncio.gather(*tasks)
         except BaseException:

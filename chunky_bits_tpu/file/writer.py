@@ -87,18 +87,19 @@ class FileWriteBuilder:
         total_bytes = 0
 
         def encode_staged(items: list[tuple[bytes, int]]):
-            """Encode a batch of parts; same-shard-length stripes share one
-            dispatch.  Runs in a worker thread."""
-            pre: list[tuple[list, list, int]] = []
+            """Encode + hash a batch of parts; same-shard-length stripes
+            share one dispatch (and one fused native encode+hash pass).
+            Runs in a worker thread."""
+            pre: list[tuple[list, list, int, Optional[list]]] = []
             groups: dict[int, list[int]] = {}
             for i, (buf, length) in enumerate(items):
                 shard_len = (length + d - 1) // d
                 groups.setdefault(shard_len, []).append(i)
-            results: dict[int, tuple[list, list, int]] = {}
+            results: dict[int, tuple[list, list, int, Optional[list]]] = {}
             for shard_len, indices in groups.items():
                 if shard_len == 0:
                     for i in indices:
-                        results[i] = ([], [], 0)
+                        results[i] = ([], [], 0, None)
                     continue
                 shards_per_item = []
                 for i in indices:
@@ -110,12 +111,13 @@ class FileWriteBuilder:
                               for s in shards])
                     for shards in shards_per_item
                 ])
-                parity_batch = coder.encode_batch(stacked)
+                parity_batch, digest_batch = coder.encode_hash_batch(stacked)
                 for bi, i in enumerate(indices):
                     results[i] = (
                         shards_per_item[bi],
                         list(parity_batch[bi]),
                         shard_len,
+                        [row.tobytes() for row in digest_batch[bi]],
                     )
             for i in range(len(items)):
                 pre.append(results[i])

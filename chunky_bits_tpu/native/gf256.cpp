@@ -1,14 +1,20 @@
-// Native GF(2^8) erasure codec — the CPU oracle backend.
+// Native GF(2^8) erasure codec + SHA-256 hashing engine — the CPU oracle
+// backend and the ingest hot path's native runtime.
 //
-// Same role as the reference's `reed-solomon-erasure` crate (CPU SIMD GF(2^8)
-// tables; reference: Cargo.toml:21, used at src/file/file_part.rs:161,302):
-// applies a GF(2^8) matrix to a batch of stacked shards.  Field is 0x11d with
-// generator 2, identical to chunky_bits_tpu/ops/gf256.py — the Python side
-// cross-checks the tables at load time.
+// Same role as the reference's `reed-solomon-erasure` crate plus its `sha2`
+// dependency (CPU SIMD GF(2^8) tables: Cargo.toml:21, used at
+// src/file/file_part.rs:161,302; per-shard SHA-256: file_part.rs:185):
+// applies a GF(2^8) matrix to a batch of stacked shards, and content-hashes
+// shards.  Field is 0x11d with generator 2, identical to
+// chunky_bits_tpu/ops/gf256.py — the Python side cross-checks the tables at
+// load time, and tests cross-check SHA-256 against hashlib.
 //
-// The inner loop uses the classic nibble-table pshufb trick under AVX2
+// The GF inner loop uses the classic nibble-table pshufb trick under AVX2
 // (c*x = T_c[x>>4 << 4] ^ T_c[x&15]) and falls back to full-table scalar
-// lookups elsewhere.  Batch items are fanned across std::threads.
+// lookups elsewhere.  SHA-256 uses the SHA-NI extension when the CPU has it
+// (runtime dispatch) and a portable scalar path otherwise.  `cb_encode_hash`
+// fuses parity + per-shard hashing in one pass per batch item while the
+// shard bytes are cache-hot.  Batch items are fanned across std::threads.
 
 #include <cstddef>
 #include <cstdint>
@@ -16,7 +22,7 @@
 #include <thread>
 #include <vector>
 
-#ifdef __AVX2__
+#if defined(__AVX2__) || defined(__x86_64__)
 #include <immintrin.h>
 #endif
 
@@ -111,6 +117,188 @@ void apply_one(const uint8_t* mat, size_t r, size_t k,
     }
 }
 
+// ---- SHA-256 ----
+
+namespace sha256 {
+
+constexpr uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5,
+    0x3956c25b, 0x59f111f1, 0x923f82a4, 0xab1c5ed5,
+    0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174,
+    0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7,
+    0xc6e00bf3, 0xd5a79147, 0x06ca6351, 0x14292967,
+    0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3,
+    0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5,
+    0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f, 0x682e6ff3,
+    0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+};
+
+constexpr uint32_t H0[8] = {
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+};
+
+inline uint32_t rotr(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+}
+
+void transform_scalar(uint32_t* st, const uint8_t* p, size_t blocks) {
+    uint32_t w[64];
+    for (; blocks; blocks--, p += 64) {
+        for (int i = 0; i < 16; i++) {
+            w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16)
+                 | (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+        }
+        for (int i = 16; i < 64; i++) {
+            uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18)
+                        ^ (w[i - 15] >> 3);
+            uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19)
+                        ^ (w[i - 2] >> 10);
+            w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+        }
+        uint32_t a = st[0], b = st[1], c = st[2], d = st[3];
+        uint32_t e = st[4], f = st[5], g = st[6], h = st[7];
+        for (int i = 0; i < 64; i++) {
+            uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+            uint32_t ch = (e & f) ^ (~e & g);
+            uint32_t t1 = h + S1 + ch + K[i] + w[i];
+            uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+            uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+            uint32_t t2 = S0 + maj;
+            h = g; g = f; f = e; e = d + t1;
+            d = c; c = b; b = a; a = t1 + t2;
+        }
+        st[0] += a; st[1] += b; st[2] += c; st[3] += d;
+        st[4] += e; st[5] += f; st[6] += g; st[7] += h;
+    }
+}
+
+#if defined(__x86_64__) && defined(__GNUC__)
+#define CB_HAVE_SHANI 1
+// Intel SHA extensions path; layout (ABEF/CDGH packing, per-4-round
+// message recurrence) follows the standard published pattern.
+__attribute__((target("sha,sse4.1,ssse3")))
+void transform_shani(uint32_t* st, const uint8_t* p, size_t blocks) {
+    const __m128i mask =
+        _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+    __m128i tmp = _mm_loadu_si128(reinterpret_cast<const __m128i*>(st));
+    __m128i st1 = _mm_loadu_si128(reinterpret_cast<const __m128i*>(st + 4));
+    tmp = _mm_shuffle_epi32(tmp, 0xB1);        // CDAB
+    st1 = _mm_shuffle_epi32(st1, 0x1B);        // EFGH
+    __m128i st0 = _mm_alignr_epi8(tmp, st1, 8);   // ABEF
+    st1 = _mm_blend_epi16(st1, tmp, 0xF0);        // CDGH
+
+    for (; blocks; blocks--, p += 64) {
+        __m128i save0 = st0, save1 = st1;
+        __m128i msgs[4];
+        for (int i = 0; i < 4; i++) {
+            msgs[i] = _mm_shuffle_epi8(
+                _mm_loadu_si128(
+                    reinterpret_cast<const __m128i*>(p + 16 * i)),
+                mask);
+            __m128i m = _mm_add_epi32(
+                msgs[i],
+                _mm_loadu_si128(
+                    reinterpret_cast<const __m128i*>(K + 4 * i)));
+            st1 = _mm_sha256rnds2_epu32(st1, st0, m);
+            m = _mm_shuffle_epi32(m, 0x0E);
+            st0 = _mm_sha256rnds2_epu32(st0, st1, m);
+        }
+        for (int i = 4; i < 16; i++) {
+            __m128i w = _mm_sha256msg1_epu32(msgs[(i - 4) & 3],
+                                             msgs[(i - 3) & 3]);
+            w = _mm_add_epi32(
+                w, _mm_alignr_epi8(msgs[(i - 1) & 3], msgs[(i - 2) & 3], 4));
+            w = _mm_sha256msg2_epu32(w, msgs[(i - 1) & 3]);
+            msgs[i & 3] = w;
+            __m128i m = _mm_add_epi32(
+                w, _mm_loadu_si128(
+                       reinterpret_cast<const __m128i*>(K + 4 * i)));
+            st1 = _mm_sha256rnds2_epu32(st1, st0, m);
+            m = _mm_shuffle_epi32(m, 0x0E);
+            st0 = _mm_sha256rnds2_epu32(st0, st1, m);
+        }
+        st0 = _mm_add_epi32(st0, save0);
+        st1 = _mm_add_epi32(st1, save1);
+    }
+
+    tmp = _mm_shuffle_epi32(st0, 0x1B);        // FEBA
+    st1 = _mm_shuffle_epi32(st1, 0xB1);        // DCHG
+    st0 = _mm_blend_epi16(tmp, st1, 0xF0);     // DCBA
+    st1 = _mm_alignr_epi8(st1, tmp, 8);        // HGFE
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(st), st0);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(st + 4), st1);
+}
+#endif
+
+using TransformFn = void (*)(uint32_t*, const uint8_t*, size_t);
+
+TransformFn pick_transform() {
+#ifdef CB_HAVE_SHANI
+    if (__builtin_cpu_supports("sha")) return transform_shani;
+#endif
+    return transform_scalar;
+}
+
+const TransformFn kTransform = pick_transform();
+
+void digest(const uint8_t* data, size_t len, uint8_t out[32]) {
+    uint32_t st[8];
+    std::memcpy(st, H0, sizeof(st));
+    size_t blocks = len / 64;
+    kTransform(st, data, blocks);
+    // final 1-2 blocks: remainder + 0x80 pad + 64-bit big-endian bit length
+    uint8_t tail[128];
+    size_t rem = len - blocks * 64;
+    std::memcpy(tail, data + blocks * 64, rem);
+    tail[rem] = 0x80;
+    size_t tail_len = rem + 1 <= 56 ? 64 : 128;
+    std::memset(tail + rem + 1, 0, tail_len - rem - 1 - 8);
+    uint64_t bits = uint64_t(len) * 8;
+    for (int i = 0; i < 8; i++) {
+        tail[tail_len - 1 - i] = uint8_t(bits >> (8 * i));
+    }
+    kTransform(st, tail, tail_len / 64);
+    for (int i = 0; i < 8; i++) {
+        out[4 * i + 0] = uint8_t(st[i] >> 24);
+        out[4 * i + 1] = uint8_t(st[i] >> 16);
+        out[4 * i + 2] = uint8_t(st[i] >> 8);
+        out[4 * i + 3] = uint8_t(st[i]);
+    }
+}
+
+}  // namespace sha256
+
+// Run `fn(i)` for i in [0, n) across up to `nthreads` std::threads
+// (<=0 => hardware concurrency).
+template <typename Fn>
+void parallel_for(size_t n, int nthreads, Fn fn) {
+    size_t want = nthreads > 0
+        ? static_cast<size_t>(nthreads)
+        : static_cast<size_t>(std::thread::hardware_concurrency());
+    if (want == 0) want = 1;
+    size_t threads = want < n ? want : n;
+    if (threads <= 1) {
+        for (size_t i = 0; i < n; i++) fn(i);
+        return;
+    }
+    std::vector<std::thread> pool;
+    pool.reserve(threads);
+    for (size_t t = 0; t < threads; t++) {
+        pool.emplace_back([=]() {
+            for (size_t i = t; i < n; i += threads) fn(i);
+        });
+    }
+    for (auto& th : pool) th.join();
+}
+
 }  // namespace
 
 extern "C" {
@@ -120,30 +308,56 @@ void cb_apply_matrix(const uint8_t* mat, size_t r, size_t k,
                      const uint8_t* shards, size_t b, size_t s,
                      uint8_t* out, int nthreads) {
     if (!kInited || r == 0 || b == 0 || s == 0) return;
-    size_t want = nthreads > 0
-        ? static_cast<size_t>(nthreads)
-        : static_cast<size_t>(std::thread::hardware_concurrency());
-    if (want == 0) want = 1;
-    size_t threads = want < b ? want : b;
-    if (threads <= 1) {
-        for (size_t i = 0; i < b; i++) {
-            apply_one(mat, r, k, shards + i * k * s, s, out + i * r * s);
-        }
-        return;
-    }
-    std::vector<std::thread> pool;
-    pool.reserve(threads);
-    for (size_t t = 0; t < threads; t++) {
-        pool.emplace_back([=]() {
-            for (size_t i = t; i < b; i += threads) {
-                apply_one(mat, r, k, shards + i * k * s, s, out + i * r * s);
-            }
-        });
-    }
-    for (auto& th : pool) th.join();
+    parallel_for(b, nthreads, [=](size_t i) {
+        apply_one(mat, r, k, shards + i * k * s, s, out + i * r * s);
+    });
 }
 
 // Table self-check hook: lets Python assert C++ and numpy agree on the field.
 uint8_t cb_gf_mul(uint8_t a, uint8_t b) { return MUL[a][b]; }
+
+// SHA-256 of one buffer (SHA-NI when available).
+void cb_sha256(const uint8_t* data, size_t len, uint8_t* out) {
+    sha256::digest(data, len, out);
+}
+
+// 1 when the SHA-NI fast path is active (introspection for tests/bench).
+int cb_sha256_is_accelerated(void) {
+#ifdef CB_HAVE_SHANI
+    return sha256::kTransform == sha256::transform_shani ? 1 : 0;
+#else
+    return 0;
+#endif
+}
+
+// Hash n contiguous rows of length s: out[i*32..] = sha256(rows[i*s..]).
+void cb_sha256_rows(const uint8_t* rows, size_t n, size_t s,
+                    uint8_t* out, int nthreads) {
+    parallel_for(n, nthreads, [=](size_t i) {
+        sha256::digest(rows + i * s, s, out + i * 32);
+    });
+}
+
+// Fused ingest step: parity + per-shard content hashes in one pass per
+// batch item, while the item's shards are cache-hot.
+//   out_parity[b, r, s]       = mat[r, k] (x) shards[b, k, s]
+//   out_hashes[b, k + r, 32]  = sha256 of each data then parity shard
+void cb_encode_hash(const uint8_t* mat, size_t r, size_t k,
+                    const uint8_t* shards, size_t b, size_t s,
+                    uint8_t* out_parity, uint8_t* out_hashes, int nthreads) {
+    if (!kInited || b == 0 || s == 0) return;
+    parallel_for(b, nthreads, [=](size_t i) {
+        const uint8_t* item = shards + i * k * s;
+        uint8_t* parity = out_parity + i * r * s;
+        uint8_t* hashes = out_hashes + i * (k + r) * 32;
+        if (r > 0) apply_one(mat, r, k, item, s, parity);
+        for (size_t j = 0; j < k; j++) {
+            sha256::digest(item + j * s, s, hashes + j * 32);
+        }
+        for (size_t j = 0; j < r; j++) {
+            sha256::digest(parity + j * s, s, hashes + (k + j) * 32);
+        }
+    });
+}
 
 }  // extern "C"

@@ -20,6 +20,7 @@ All three produce byte-identical shards; tests assert it.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 from abc import ABC, abstractmethod
@@ -122,6 +123,34 @@ def get_backend(name: Optional[str] = None) -> ErasureBackend:
     return backend
 
 
+def _hash_rows_hashlib(rows: np.ndarray, out: np.ndarray) -> None:
+    """out[b, j] = sha256(rows[b, j]) for uint8 rows[B, n, S]."""
+    for i in range(rows.shape[0]):
+        for j in range(rows.shape[1]):
+            out[i, j] = np.frombuffer(
+                hashlib.sha256(rows[i, j]).digest(), dtype=np.uint8)
+
+
+_ROW_HASHER = None
+
+
+def _row_hasher():
+    """Bulk shard hasher for non-native parity backends (e.g. jax): the
+    native SHA-NI engine hashing all rows in one threaded GIL-free call,
+    or a hashlib loop when the C++ library can't build."""
+    global _ROW_HASHER
+    if _ROW_HASHER is None:
+        try:
+            from chunky_bits_tpu.ops.cpu_backend import (sha256_buf,
+                                                         sha256_rows)
+
+            sha256_buf(b"")  # force the deferred C++ build now
+            _ROW_HASHER = sha256_rows
+        except Exception:
+            _ROW_HASHER = _hash_rows_hashlib
+    return _ROW_HASHER
+
+
 _CODER_CACHE: dict[tuple[int, int, str], "ErasureCoder"] = {}
 _CODER_LOCK = threading.Lock()
 
@@ -158,6 +187,37 @@ class ErasureCoder:
             b, _, s = data.shape
             return np.zeros((b, 0, s), dtype=np.uint8)
         return self.backend.apply_matrix(self.parity_rows, data)
+
+    def encode_hash_batch(
+        self, data: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Parity plus per-shard content hashes for a batch of parts —
+        the ingest step's full compute (reference: encode at
+        src/file/file_part.rs:161-165, per-shard sha256 at :185).
+
+        Returns ``(parity[B, p, S], digests[B, d+p, 32])`` with digest
+        rows ordered data shards then parity shards.  Backends exposing a
+        fused ``encode_and_hash`` (the native C++ engine) do both in one
+        cache-hot pass; otherwise parity comes from ``encode_batch`` and
+        hashing falls back to hashlib.
+        """
+        if data.ndim != 3 or data.shape[1] != self.data:
+            raise ErasureError(
+                f"expected data shaped [B, {self.data}, S], got {data.shape}"
+            )
+        fused = getattr(self.backend, "encode_and_hash", None)
+        if fused is not None:
+            return fused(self.parity_rows, np.ascontiguousarray(data))
+        parity = self.encode_batch(data)
+        b, _, _ = data.shape
+        hash_rows = _row_hasher()
+        data_digests = np.empty((b, self.data, 32), dtype=np.uint8)
+        hash_rows(np.ascontiguousarray(data), data_digests)
+        if not self.parity:
+            return parity, data_digests
+        parity_digests = np.empty((b, self.parity, 32), dtype=np.uint8)
+        hash_rows(parity, parity_digests)
+        return parity, np.concatenate([data_digests, parity_digests], axis=1)
 
     def reconstruct_batch(
         self, shards: np.ndarray, present: Sequence[int],

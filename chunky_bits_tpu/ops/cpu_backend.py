@@ -80,12 +80,66 @@ def _load():
         lib.cb_apply_matrix.restype = None
         lib.cb_gf_mul.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
         lib.cb_gf_mul.restype = ctypes.c_uint8
+        lib.cb_sha256.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_void_p,
+        ]
+        lib.cb_sha256.restype = None
+        lib.cb_sha256_is_accelerated.argtypes = []
+        lib.cb_sha256_is_accelerated.restype = ctypes.c_int
+        lib.cb_sha256_rows.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.cb_sha256_rows.restype = None
+        lib.cb_encode_hash.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.cb_encode_hash.restype = None
         # Field self-check: C++ tables must agree with the Python tables.
         for a, b in ((2, 0x80), (3, 7), (255, 255), (29, 1)):
             if lib.cb_gf_mul(a, b) != gf256.gf_mul(a, b):
                 raise ErasureError("native GF tables disagree with python")
+        # Hash self-check: one KAT against hashlib.
+        probe = b"chunky-bits-tpu sha self-check"
+        out = ctypes.create_string_buffer(32)
+        lib.cb_sha256(probe, len(probe), out)
+        if out.raw != hashlib.sha256(probe).digest():
+            raise ErasureError("native sha256 disagrees with hashlib")
         _LIB = lib
     return _LIB
+
+
+def sha256_buf(data) -> bytes:
+    """Native one-shot SHA-256 (SHA-NI when the CPU has it)."""
+    lib = _load()
+    out = ctypes.create_string_buffer(32)
+    data = bytes(data)
+    lib.cb_sha256(data, len(data), out)
+    return out.raw
+
+
+def sha256_is_accelerated() -> bool:
+    return bool(_load().cb_sha256_is_accelerated())
+
+
+def sha256_rows(rows: np.ndarray, out: np.ndarray) -> None:
+    """out[..., 32] = sha256 of each row of uint8 rows[..., S], hashed by
+    the native engine in one threaded, GIL-free call."""
+    lib = _load()
+    n = int(np.prod(rows.shape[:-1]))
+    if n == 0 or rows.shape[-1] == 0:
+        out[...] = np.frombuffer(
+            hashlib.sha256(b"").digest(), dtype=np.uint8)
+        return
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+    if not out.flags.c_contiguous:
+        raise ErasureError("sha256_rows needs a contiguous output")
+    lib.cb_sha256_rows(
+        rows.ctypes.data_as(ctypes.c_char_p), n, rows.shape[-1],
+        out.ctypes.data_as(ctypes.c_void_p), 0,
+    )
 
 
 class NativeBackend(ErasureBackend):
@@ -111,3 +165,26 @@ class NativeBackend(ErasureBackend):
             out.ctypes.data_as(ctypes.c_void_p), self.nthreads,
         )
         return out
+
+    def encode_and_hash(
+        self, mat: np.ndarray, shards: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Fused ingest step: parity[b, r, s] plus sha256 digests
+        [b, k + r, 32] of every data-then-parity shard, one native pass
+        per batch item (the shard stays cache-hot between GF math and
+        hashing, and the GIL is released once for the whole batch)."""
+        b, k, s = shards.shape
+        r = mat.shape[0]
+        parity = np.zeros((b, r, s), dtype=np.uint8)
+        hashes = np.zeros((b, k + r, 32), dtype=np.uint8)
+        if b == 0 or s == 0:
+            return parity, hashes
+        mat = np.ascontiguousarray(mat, dtype=np.uint8)
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        self._lib.cb_encode_hash(
+            mat.ctypes.data_as(ctypes.c_char_p), r, k,
+            shards.ctypes.data_as(ctypes.c_char_p), b, s,
+            parity.ctypes.data_as(ctypes.c_void_p),
+            hashes.ctypes.data_as(ctypes.c_void_p), self.nthreads,
+        )
+        return parity, hashes
