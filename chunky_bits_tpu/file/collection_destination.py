@@ -121,6 +121,21 @@ class LocationsDestination(_BaseDestination):
                 for loc in self.locations[:count]]
 
 
+def as_destination(obj) -> "CollectionDestination":
+    """Coerce the shapes the reference accepts as destinations: a
+    CollectionDestination passes through; a list of WeightedLocations
+    becomes weighted sampling (collection_destination.rs:56-73); a list
+    of Locations (or location strings) becomes first-N placement
+    (collection_destination.rs:75-84); None/() becomes the void."""
+    if obj is None or obj == ():
+        return VoidDestination()
+    if isinstance(obj, (list, tuple)):
+        if obj and all(isinstance(x, WeightedLocation) for x in obj):
+            return WeightedLocationsDestination(obj)
+        return LocationsDestination(obj)
+    return obj
+
+
 class _VoidWriter:
     async def write_shard(self, hash_: AnyHash, data: bytes) -> list[Location]:
         return []

@@ -74,12 +74,10 @@ class FileWriteBuilder:
         batch_parts = max(1, min(self.batch_parts, self.concurrency))
         d, p = self.data, self.parity
         coder = get_coder(d, p, self.backend)
-        destination = self.destination
-        if destination is None:
-            from chunky_bits_tpu.file.collection_destination import \
-                VoidDestination
+        from chunky_bits_tpu.file.collection_destination import \
+            as_destination
 
-            destination = VoidDestination()
+        destination = as_destination(self.destination)
 
         sem = asyncio.Semaphore(self.concurrency)
         part_tasks: list[asyncio.Task] = []

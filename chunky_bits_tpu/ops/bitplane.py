@@ -11,12 +11,12 @@ single-device einsum path (ops/jax_backend.py), the mesh-sharded path
 from __future__ import annotations
 
 
-def apply_bitplane(m2, shards):
-    """m2: bf16 [r8, k8] of 0/1; shards: uint8 [B, k, S] -> uint8 [B, r, S].
-
-    Products are 0/1 and the contraction length is <= 2048, so bf16 inputs
-    with f32 accumulation are exact; the mod-2 keeps only the XOR parity.
-    """
+def bitplane_acc(m2, shards):
+    """Raw bit-plane accumulation: int32 [B, r8, S] of popcounts, *before*
+    the mod-2.  Split out so the wide-stripe mesh path (parallel/mesh.py)
+    can ``psum`` partial accumulations across chips — GF(2^8) addition is
+    XOR, so summing integer popcounts over chips and taking mod-2 once at
+    the end is exact."""
     import jax.numpy as jnp
 
     b, k, s = shards.shape
@@ -25,7 +25,25 @@ def apply_bitplane(m2, shards):
     bits = bits.reshape(b, k * 8, s).astype(jnp.bfloat16)
     acc = jnp.einsum("rk,bks->brs", m2, bits,
                      preferred_element_type=jnp.float32)
-    out_bits = acc.astype(jnp.int32) & 1
-    out_bits = out_bits.reshape(b, m2.shape[0] // 8, 8, s)
+    return acc.astype(jnp.int32)
+
+
+def pack_acc(acc):
+    """Pack int32 popcounts [B, r8, S] into bytes [B, r, S] via mod-2."""
+    import jax.numpy as jnp
+
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    b, r8, s = acc.shape
+    out_bits = acc & 1
+    out_bits = out_bits.reshape(b, r8 // 8, 8, s)
     packed = jnp.sum(out_bits << shifts[None, None, :, None], axis=2)
     return packed.astype(jnp.uint8)
+
+
+def apply_bitplane(m2, shards):
+    """m2: bf16 [r8, k8] of 0/1; shards: uint8 [B, k, S] -> uint8 [B, r, S].
+
+    Products are 0/1 and the contraction length is <= 2048, so bf16 inputs
+    with f32 accumulation are exact; the mod-2 keeps only the XOR parity.
+    """
+    return pack_acc(bitplane_acc(m2, shards))

@@ -178,6 +178,11 @@ class NativeBackend(ErasureBackend):
         parity = np.zeros((b, r, s), dtype=np.uint8)
         hashes = np.zeros((b, k + r, 32), dtype=np.uint8)
         if b == 0 or s == 0:
+            # zero-length shards still hash: digest must be sha256(b""),
+            # matching the generic fallback (ops/backend.py)
+            if b and s == 0:
+                hashes[:, :] = np.frombuffer(
+                    hashlib.sha256(b"").digest(), dtype=np.uint8)
             return parity, hashes
         mat = np.ascontiguousarray(mat, dtype=np.uint8)
         shards = np.ascontiguousarray(shards, dtype=np.uint8)

@@ -2,6 +2,9 @@
 
 from chunky_bits_tpu.parallel.mesh import (  # noqa: F401
     encode_step_sharded,
+    encode_wide_sharded,
     make_mesh,
+    make_stripe_mesh,
     sharded_apply,
+    wide_apply_sharded,
 )

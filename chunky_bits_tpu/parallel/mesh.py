@@ -11,11 +11,18 @@ The reference scales with HTTP fan-out across storage nodes
   bytes, so a single huge stripe can be split across chips the way sequence
   parallelism splits a long context — each chip transforms its byte range,
   no halo exchange needed.
+* ``tp`` — the stripe axis (wide stripes, BASELINE.md config 5: d=20 p=6
+  over a v5e-8): the *contraction* dimension of the GF matmul is split, so
+  each chip holds d/tp data shards and computes a partial bit-plane
+  product; full parity emerges from an integer ``psum`` over ``tp``
+  followed by a single mod-2 — exact because GF(2^8) addition is XOR and
+  popcounts add over chips.  This is the tensor-parallel decomposition of
+  erasure coding: the per-chip working set shrinks with the stripe width,
+  and the only cross-chip traffic is the [B, p*8, S] accumulator riding ICI.
 
-The bit-matrix is tiny (<=2048x2048 bits) and replicated.  The only
-collective is a ``psum`` checksum reduction used to validate mesh execution
-(and as the pattern for future cross-chip reductions, e.g. distributed
-scrub/verify aggregation); shards ride ICI via the mesh, never DCN.
+The bit-matrix is tiny (<=2048x2048 bits) and replicated (column-sharded
+over ``tp`` in the wide-stripe path).  Collectives are the ``tp`` psum and a
+checksum psum used to validate mesh execution; shards ride ICI, never DCN.
 """
 
 from __future__ import annotations
@@ -28,29 +35,55 @@ import numpy as np
 from chunky_bits_tpu.ops import gf256
 
 
-def make_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None,
-              sp: Optional[int] = None):
-    """Build a ('dp', 'sp') mesh over the first n devices."""
+def _shard_map():
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map
+
+
+def _make_mesh_2d(n_devices, first, first_name, second, second_name):
     import jax
     from jax.sharding import Mesh
 
     devices = jax.devices()
     n = n_devices or len(devices)
     devices = devices[:n]
-    if dp is None and sp is None:
-        sp = 1
-        dp = n
-    elif dp is None:
-        dp = n // sp
-    elif sp is None:
-        sp = n // dp
-    if dp * sp != n:
-        raise ValueError(f"dp({dp}) * sp({sp}) != devices({n})")
-    mesh_devices = np.array(devices).reshape(dp, sp)
-    return Mesh(mesh_devices, ("dp", "sp"))
+    if first is None and second is None:
+        second = 1
+        first = n
+    elif first is None:
+        first = n // second
+    elif second is None:
+        second = n // first
+    if first * second != n:
+        raise ValueError(
+            f"{first_name}({first}) * {second_name}({second}) "
+            f"!= devices({n})")
+    mesh_devices = np.array(devices).reshape(first, second)
+    return Mesh(mesh_devices, (first_name, second_name))
+
+
+def make_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None,
+              sp: Optional[int] = None):
+    """Build a ('dp', 'sp') mesh over the first n devices."""
+    return _make_mesh_2d(n_devices, dp, "dp", sp, "sp")
+
+
+def make_stripe_mesh(n_devices: Optional[int] = None,
+                     dp: Optional[int] = None, tp: Optional[int] = None):
+    """Build a ('dp', 'tp') mesh for wide-stripe (contraction-sharded)
+    encode/decode; ``tp`` must divide the stripe's data-shard count."""
+    return _make_mesh_2d(n_devices, dp, "dp", tp, "tp")
 
 
 from chunky_bits_tpu.ops.bitplane import apply_bitplane as _apply_local
+from chunky_bits_tpu.ops.bitplane import bitplane_acc as _acc_local
+from chunky_bits_tpu.ops.bitplane import pack_acc as _pack_acc
 
 
 @functools.lru_cache(maxsize=16)
@@ -73,10 +106,9 @@ def _sharded_apply_fn(mesh):
     """Jitted shard_mapped transform, cached per mesh so repeated calls
     reuse the XLA executable instead of retracing."""
     import jax
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
-    return jax.jit(shard_map(
+    return jax.jit(_shard_map()(
         _apply_local,
         mesh=mesh,
         in_specs=(P(None, None), P("dp", None, "sp")),
@@ -102,7 +134,6 @@ def sharded_apply(mesh, mat: np.ndarray, shards):
 def _encode_step_fn(mesh):
     import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     def step(m2, shards):
@@ -111,7 +142,7 @@ def _encode_step_fn(mesh):
         checksum = jax.lax.psum(jax.lax.psum(local_sum, "dp"), "sp")
         return parity, checksum
 
-    return jax.jit(shard_map(
+    return jax.jit(_shard_map()(
         step,
         mesh=mesh,
         in_specs=(P(None, None), P("dp", None, "sp")),
@@ -131,3 +162,61 @@ def encode_step_sharded(mesh, encode_matrix: np.ndarray, data):
     parity_rows = np.ascontiguousarray(encode_matrix[d:], dtype=np.uint8)
     m2 = _device_bit_matrix(parity_rows.tobytes(), *parity_rows.shape)
     return _encode_step_fn(mesh)(m2, jnp.asarray(data))
+
+
+# ---------------------------------------------------------------------------
+# Wide-stripe (contraction-sharded) path — BASELINE.md config 5.
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=16)
+def _wide_apply_fn(mesh):
+    """Jitted transform with the GF contraction split over 'tp'.
+
+    Each chip holds a [B/dp, K/tp, S] slice of the input shards and the
+    matching [R8, K8/tp] column block of the bit-matrix; it computes the
+    partial popcount accumulation, which is integer-``psum``'d over 'tp'
+    (popcounts add across chips because GF(2^8) addition is XOR) and packed
+    to bytes with one final mod-2.  Output is replicated within each 'tp'
+    group — every chip in the group ends up with the full parity for its
+    'dp' slice of parts, ready for the host gather.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def step(m2_cols, shards_local):
+        acc = _acc_local(m2_cols, shards_local)
+        acc = jax.lax.psum(acc, "tp")
+        return _pack_acc(acc)
+
+    return jax.jit(_shard_map()(
+        step,
+        mesh=mesh,
+        in_specs=(P(None, "tp"), P("dp", "tp", None)),
+        out_specs=P("dp", None, None),
+    ))
+
+
+def wide_apply_sharded(mesh, mat: np.ndarray, shards):
+    """out[B, R, S] = mat ⊗ shards with B over 'dp' and the K (stripe)
+    axis over 'tp'.  ``mat`` is a GF(2^8) matrix [R, K] (parity rows for
+    encode, host-inverted rows for decode — the same primitive serves
+    both, like the reference's encode_sep/reconstruct pair at
+    src/file/file_part.rs:161,302).  'tp' must divide K.
+    """
+    import jax.numpy as jnp
+
+    tp = mesh.shape["tp"]
+    r, k = mat.shape
+    if k % tp != 0:
+        raise ValueError(f"stripe width {k} not divisible by tp={tp}")
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    m2 = _device_bit_matrix(mat.tobytes(), r, k)
+    return _wide_apply_fn(mesh)(m2, jnp.asarray(shards))
+
+
+def encode_wide_sharded(mesh, encode_matrix: np.ndarray, data):
+    """Wide-stripe parity: data uint8 [B, d, S] with d split over 'tp'
+    (and B over 'dp') -> parity uint8 [B, p, S]."""
+    d = encode_matrix.shape[1]
+    parity_rows = np.ascontiguousarray(encode_matrix[d:], dtype=np.uint8)
+    return wide_apply_sharded(mesh, parity_rows, data)

@@ -113,3 +113,46 @@ def test_writer_fused_refs_match_plain():
                     for c in part.all_chunks()]
 
     asyncio.run(main())
+
+
+def test_writer_hashes_match_persisted_bytes(tmp_path):
+    """Ground truth for the digest plumbing: every chunk hash in the
+    written reference must be the sha256 of the bytes actually persisted
+    at that chunk's location — catching any mis-zip of precomputed
+    digests to shards (order, data-vs-parity) that a same-code-path
+    comparison cannot see."""
+    import asyncio
+    import hashlib as _hl
+
+    from chunky_bits_tpu.file.location import Location
+    from chunky_bits_tpu.file.writer import FileWriteBuilder
+    from chunky_bits_tpu.utils import aio
+
+    rng = np.random.default_rng(29)
+    payload = rng.integers(0, 256, 3 * 4096 * 3 + 123,
+                           dtype=np.uint8).tobytes()
+
+    async def main():
+        backends = ["numpy"] + (["native"] if NativeBackend else [])
+        for backend in backends:
+            root = tmp_path / backend
+            root.mkdir()
+            builder = (FileWriteBuilder()
+                       .with_chunk_size(4096)
+                       .with_data_chunks(3)
+                       .with_parity_chunks(2)
+                       .with_batch_parts(4)
+                       .with_backend(backend)
+                       .with_destination([Location.parse(str(root))] * 5))
+            ref = await builder.write(aio.BytesReader(payload))
+            n_checked = 0
+            for part in ref.parts:
+                for chunk in part.all_chunks():
+                    stored = await chunk.locations[0].read()
+                    digest = _hl.sha256(stored).hexdigest()
+                    assert str(chunk.hash) == f"sha256-{digest}"
+                    n_checked += 1
+            # 3 full parts + 1 short tail part, (3 data + 2 parity) each
+            assert n_checked == 4 * 5
+
+    asyncio.run(main())
