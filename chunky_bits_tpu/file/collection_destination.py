@@ -126,13 +126,23 @@ def as_destination(obj) -> "CollectionDestination":
     CollectionDestination passes through; a list of WeightedLocations
     becomes weighted sampling (collection_destination.rs:56-73); a list
     of Locations (or location strings) becomes first-N placement
-    (collection_destination.rs:75-84); None/() becomes the void."""
-    if obj is None or obj == ():
+    (collection_destination.rs:75-84); None becomes the void (the
+    builder's default, like the reference's ``()`` unit destination).
+
+    An *empty* collection is a LocationsDestination that raises
+    NotEnoughWriters on use — never a silent discard; mixing weighted
+    and unweighted entries is a type error rather than a repr-parse."""
+    if obj is None:
         return VoidDestination()
     if isinstance(obj, (list, tuple)):
-        if obj and all(isinstance(x, WeightedLocation) for x in obj):
-            return WeightedLocationsDestination(obj)
-        return LocationsDestination(obj)
+        n_weighted = sum(isinstance(x, WeightedLocation) for x in obj)
+        if n_weighted and n_weighted != len(obj):
+            raise TypeError(
+                "destination list mixes WeightedLocation with plain "
+                "locations; use one or the other")
+        if obj and n_weighted == len(obj):
+            return WeightedLocationsDestination(list(obj))
+        return LocationsDestination(list(obj))
     return obj
 
 

@@ -223,7 +223,13 @@ class FilePart:
         writers = destination.get_writers(d + p)
 
         async def hash_and_write(payload, writer, digest) -> Chunk:
-            payload = bytes(payload)
+            # Zero-copy normalization: numpy rows and memoryviews flow
+            # through to the writers as buffers; only exotic payloads pay
+            # a bytes() copy.
+            if isinstance(payload, np.ndarray):
+                payload = memoryview(np.ascontiguousarray(payload))
+            elif not isinstance(payload, (bytes, bytearray, memoryview)):
+                payload = bytes(payload)
             if digest is not None:
                 hash_ = AnyHash.sha256(Sha256Hash(digest))
             else:

@@ -23,7 +23,7 @@ from typing import Optional
 import numpy as np
 
 from chunky_bits_tpu.errors import FileWriteError
-from chunky_bits_tpu.file.file_part import FilePart, split_into_shards
+from chunky_bits_tpu.file.file_part import FilePart
 from chunky_bits_tpu.file.file_reference import FileReference
 from chunky_bits_tpu.ops import get_coder
 from chunky_bits_tpu.utils import aio
@@ -80,15 +80,20 @@ class FileWriteBuilder:
         destination = as_destination(self.destination)
 
         sem = asyncio.Semaphore(self.concurrency)
-        part_tasks: list[asyncio.Task] = []
         staged: list[tuple[bytes, int]] = []  # (buffer, meaningful length)
         total_bytes = 0
 
         def encode_staged(items: list[tuple[bytes, int]]):
             """Encode + hash a batch of parts; same-shard-length stripes
             share one dispatch (and one fused native encode+hash pass).
-            Runs in a worker thread."""
-            pre: list[tuple[list, list, int, Optional[list]]] = []
+            Runs in a worker thread.
+
+            Copies each staged part buffer exactly once, into a
+            preallocated [B, d, S] staging array; the shard payloads
+            handed to the writers are zero-copy row views of that array
+            (and of the parity batch), so the ingest path moves each
+            byte host-side only twice: reader -> staging, staging ->
+            destination."""
             groups: dict[int, list[int]] = {}
             for i, (buf, length) in enumerate(items):
                 shard_len = (length + d - 1) // d
@@ -99,27 +104,24 @@ class FileWriteBuilder:
                     for i in indices:
                         results[i] = ([], [], 0, None)
                     continue
-                shards_per_item = []
-                for i in indices:
+                stacked = np.empty((len(indices), d, shard_len),
+                                   dtype=np.uint8)
+                for bi, i in enumerate(indices):
                     buf, length = items[i]
-                    shards, _ = split_into_shards(buf, length, d)
-                    shards_per_item.append(shards)
-                stacked = np.stack([
-                    np.stack([np.frombuffer(s, dtype=np.uint8)
-                              for s in shards])
-                    for shards in shards_per_item
-                ])
+                    flat = stacked[bi].reshape(d * shard_len)
+                    flat[:length] = np.frombuffer(buf, dtype=np.uint8,
+                                                  count=length)
+                    if length < d * shard_len:
+                        flat[length:] = 0
                 parity_batch, digest_batch = coder.encode_hash_batch(stacked)
                 for bi, i in enumerate(indices):
                     results[i] = (
-                        shards_per_item[bi],
+                        list(stacked[bi]),
                         list(parity_batch[bi]),
                         shard_len,
                         [row.tobytes() for row in digest_batch[bi]],
                     )
-            for i in range(len(items)):
-                pre.append(results[i])
-            return pre
+            return [results[i] for i in range(len(items))]
 
         async def write_part(precomputed) -> FilePart:
             try:
@@ -129,18 +131,56 @@ class FileWriteBuilder:
             finally:
                 sem.release()
 
-        async def flush() -> None:
+        batch_tasks: list[asyncio.Task] = []
+
+        async def run_batch(items) -> list[FilePart]:
+            try:
+                pre = await asyncio.to_thread(encode_staged, items)
+            except BaseException:
+                for _ in items:
+                    sem.release()
+                raise
+            tasks = [asyncio.ensure_future(write_part(x)) for x in pre]
+            try:
+                return await asyncio.gather(*tasks)
+            except BaseException:
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+                raise
+
+        def flush() -> None:
+            """Hand the staged parts to a background encode+write task —
+            the read loop keeps streaming while the previous batch is on
+            the device / in flight to storage (double buffering; the
+            semaphore still bounds total parts in flight)."""
             items, staged[:] = staged[:], []
-            if not items:
-                return
-            pre = await asyncio.to_thread(encode_staged, items)
-            for item in pre:
-                part_tasks.append(asyncio.ensure_future(write_part(item)))
+            if items:
+                batch_tasks.append(asyncio.create_task(run_batch(items)))
+
+        checked = 0
+
+        def check_failed() -> None:
+            """Fail fast: surface the first completed batch's error
+            without waiting for the read loop to finish (the reference's
+            oneshot error short-circuit, writer.rs:235-247).  A cursor
+            skips still-pending tasks already probed so the scan stays
+            O(batches) over the whole stream."""
+            nonlocal checked
+            while checked < len(batch_tasks):
+                t = batch_tasks[checked]
+                if not t.done():
+                    break
+                if not t.cancelled():
+                    exc = t.exception()
+                    if exc is not None:
+                        raise exc
+                checked += 1
 
         async def cancel_all() -> None:
-            for t in part_tasks:
+            for t in batch_tasks:
                 t.cancel()
-            await asyncio.gather(*part_tasks, return_exceptions=True)
+            await asyncio.gather(*batch_tasks, return_exceptions=True)
 
         try:
             while True:
@@ -156,13 +196,13 @@ class FileWriteBuilder:
                 if len(staged) >= batch_parts or short_read:
                     # the just-staged parts keep their permits until their
                     # write tasks complete
-                    await flush()
-                else:
-                    continue
+                    flush()
+                    check_failed()
                 if short_read:
                     break
-            await flush()
-            parts = await asyncio.gather(*part_tasks)
+            flush()
+            nested = await asyncio.gather(*batch_tasks)
+            parts = [part for batch in nested for part in batch]
         except BaseException:
             await cancel_all()
             raise
