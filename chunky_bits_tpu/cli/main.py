@@ -353,6 +353,13 @@ def main(argv=None) -> int:
         return 1
     except KeyboardInterrupt:
         return 130
+    except BrokenPipeError:
+        # Downstream closed (e.g. `cat ... | head`): die quietly like a
+        # coreutils tool.  Point stdout at devnull so the interpreter's
+        # exit-time flush doesn't raise a second time.
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        return 141  # 128 + SIGPIPE
 
 
 if __name__ == "__main__":

@@ -70,6 +70,22 @@ class FileIntegrity(enum.IntEnum):
         return self.name.capitalize()
 
 
+async def _reconstruct(arrays, d: int, p: int,
+                       coder: Optional[ErasureCoder], backend: Optional[str],
+                       batcher, data_only: bool):
+    """Fill the ``None`` rows of ``arrays``: through the shared batcher
+    when one is wired in (coalesced device dispatches), else via a lazily
+    resolved coder off-loop — constructing a device backend (jax init) can
+    take seconds and must neither block the event loop nor run on healthy
+    reads."""
+    if batcher is not None:
+        return await batcher.reconstruct(d, p, arrays, data_only=data_only)
+    if coder is None:
+        coder = await asyncio.to_thread(get_coder, d, p, backend)
+    fn = coder.reconstruct_data if data_only else coder.reconstruct
+    return await asyncio.to_thread(fn, arrays)
+
+
 def split_into_shards(data_buf, length: int, d: int):
     """Split ``length`` meaningful bytes (backed by a zero-padded buffer)
     into d equal shards of ceil(length/d) bytes — the reference's round-up
@@ -122,11 +138,16 @@ class FilePart:
 
     async def read(self, cx: Optional[LocationContext] = None,
                    coder: Optional[ErasureCoder] = None,
-                   backend: Optional[str] = None) -> bytes:
+                   backend: Optional[str] = None,
+                   batcher=None) -> bytes:
         """Scattered read: d workers randomly grab chunks from the shared
         d+p pool, falling through each chunk's locations; RS-reconstruct if
         any data chunk is missing.  Returns d*chunksize bytes (padding
-        included; the file reader trims)."""
+        included; the file reader trims).
+
+        ``batcher`` (an ops.batching.ReconstructBatcher) coalesces this
+        part's reconstruction with other parts in flight into one device
+        dispatch."""
         cx = cx or default_context()
         d, p = len(self.data), len(self.parity)
         pool: list[tuple[int, Chunk]] = list(enumerate(self.all_chunks()))
@@ -158,16 +179,12 @@ class FilePart:
                 raise NotEnoughChunks(
                     f"only {present} of {d}+{p} chunks readable"
                 )
-            if coder is None:
-                # Resolved lazily and off-loop: constructing a device
-                # backend (jax init) can take seconds and must neither
-                # block the event loop nor run on healthy reads.
-                coder = await asyncio.to_thread(get_coder, d, p, backend)
             arrays: list[Optional[np.ndarray]] = [
                 np.frombuffer(s, dtype=np.uint8) if s is not None else None
                 for s in slots
             ]
-            arrays = await asyncio.to_thread(coder.reconstruct_data, arrays)
+            arrays = await _reconstruct(arrays, d, p, coder, backend,
+                                        batcher, data_only=True)
             slots = [a.tobytes() if isinstance(a, np.ndarray) else a
                      for a in arrays]
         return b"".join(slots[i] for i in range(d))  # type: ignore[misc]
@@ -285,8 +302,8 @@ class FilePart:
     async def resilver(self, destination,
                        cx: Optional[LocationContext] = None,
                        coder: Optional[ErasureCoder] = None,
-                       backend: Optional[str] = None
-                       ) -> "ResilverPartReport":
+                       backend: Optional[str] = None,
+                       batcher=None) -> "ResilverPartReport":
         # Deviation from the reference: repair writes always overwrite.
         # Under the default `on_conflict: ignore` tunable the reference's
         # resilver silently keeps a corrupt chunk file when the rebuilt
@@ -328,13 +345,12 @@ class FilePart:
         if not all(chunk_status):
             # Reconstruct every missing chunk (data and parity).
             try:
-                if coder is None:
-                    coder = await asyncio.to_thread(get_coder, d, p, backend)
                 arrays: list[Optional[np.ndarray]] = [
                     np.frombuffer(b, dtype=np.uint8) if b is not None else None
                     for b in data_bufs
                 ]
-                arrays = await asyncio.to_thread(coder.reconstruct, arrays)
+                arrays = await _reconstruct(arrays, d, p, coder, backend,
+                                            batcher, data_only=False)
                 rebuilt: list[Optional[bytes]] = [
                     a.tobytes() if isinstance(a, np.ndarray) else None
                     for a in arrays

@@ -99,11 +99,17 @@ class FileReference:
                        cx: Optional[LocationContext] = None,
                        backend: Optional[str] = None
                        ) -> "ResilverFileReport":
+        from chunky_bits_tpu.ops.batching import ReconstructBatcher
+
         sem = asyncio.Semaphore(RESILVER_CONCURRENCY)
+        # All in-flight parts share one batcher: parts degraded by the same
+        # node loss share an erasure pattern and rebuild in one dispatch.
+        batcher = ReconstructBatcher(backend=backend)
 
         async def one(part: FilePart) -> ResilverPartReport:
             async with sem:
-                return await part.resilver(destination, cx, backend=backend)
+                return await part.resilver(destination, cx, backend=backend,
+                                           batcher=batcher)
 
         reports = await asyncio.gather(*[one(p) for p in self.parts])
         return ResilverFileReport(list(reports))

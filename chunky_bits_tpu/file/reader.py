@@ -69,7 +69,14 @@ class FileReadBuilder:
         return self.file
 
     async def stream(self) -> AsyncIterator[bytes]:
-        """Yield per-part byte buffers with ``buffer`` parts prefetched."""
+        """Yield per-part byte buffers with ``buffer`` parts prefetched.
+
+        The prefetched parts share one ReconstructBatcher, so a degraded
+        read of many parts rebuilds its missing shards in batched device
+        dispatches instead of one per part."""
+        from chunky_bits_tpu.ops.batching import ReconstructBatcher
+
+        batcher = ReconstructBatcher(backend=self.backend)
         jobs: list[tuple[FilePart, int]] = []
         seek = self.seek
         for part in self.file.parts:
@@ -87,7 +94,8 @@ class FileReadBuilder:
                 while idx < len(jobs) and len(tasks) < max(self.buffer, 1):
                     part, skip = jobs[idx]
                     tasks.append(
-                        asyncio.ensure_future(self._read_part(part, skip)))
+                        asyncio.ensure_future(
+                            self._read_part(part, skip, batcher)))
                     idx += 1
                 data = await tasks.popleft()
                 if len(data) > remaining:
@@ -101,10 +109,12 @@ class FileReadBuilder:
             if tasks:
                 await asyncio.gather(*tasks, return_exceptions=True)
 
-    async def _read_part(self, part: FilePart, skip: int) -> bytes:
+    async def _read_part(self, part: FilePart, skip: int,
+                         batcher=None) -> bytes:
         # backend resolution happens lazily inside part.read, only when
         # reconstruction is actually needed
-        data = await part.read(self.cx, backend=self.backend)
+        data = await part.read(self.cx, backend=self.backend,
+                               batcher=batcher)
         if len(data) > skip:
             return data[skip:] if skip else data
         return b""

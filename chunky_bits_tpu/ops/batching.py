@@ -1,0 +1,129 @@
+"""Coalesce concurrent Reed-Solomon reconstructions into batched dispatches.
+
+The reference rebuilds one part at a time on the blocking pool
+(src/file/file_part.rs:128,302-305).  That shape wastes a TPU: resilver
+keeps 10 parts in flight (src/file/file_reference.rs:110), a degraded read
+prefetches 5 (src/file/reader.rs:96), and the parts of one file almost
+always share an erasure pattern — the node that lost shard *i* of one part
+lost shard *i* of every part.  The batcher collects whatever reconstruction
+requests are in flight at the same moment, groups them by (geometry,
+erasure pattern, shard length, data-only), and rebuilds each group in a
+single ``[B, d+p, S]`` dispatch through ``ErasureCoder.reconstruct_batch``
+— one device call (or one threaded native call) instead of B.
+
+Requests that arrive while a dispatch is running accumulate and form the
+next batch, so batching emerges from concurrency without added latency:
+a lone request is dispatched immediately.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Sequence
+
+import numpy as np
+
+from chunky_bits_tpu.errors import ErasureError
+from chunky_bits_tpu.ops.backend import get_coder
+
+
+class ReconstructBatcher:
+    """Shared per-pipeline reconstruction front-end.
+
+    One instance is created per read stream / resilver run and passed down
+    to the parts; it must be used from a single event loop.
+    """
+
+    def __init__(self, backend: Optional[str] = None, max_batch: int = 128):
+        self.backend = backend
+        self.max_batch = max_batch
+        self._pending: list[tuple[tuple, list, asyncio.Future]] = []
+        self._task: Optional[asyncio.Task] = None
+        self.dispatches = 0  # observability + tests
+
+    async def reconstruct(
+        self, d: int, p: int, arrays: Sequence[Optional[np.ndarray]],
+        data_only: bool = False,
+    ) -> list[Optional[np.ndarray]]:
+        """Async equivalent of ``ErasureCoder.reconstruct`` /
+        ``reconstruct_data`` (crate call sites file_part.rs:128,302-305):
+        fill the ``None`` rows of ``arrays`` (all d+p slots, data first).
+        """
+        total = d + p
+        if len(arrays) != total:
+            raise ErasureError(
+                f"expected {total} shard slots, got {len(arrays)}")
+        arrays = list(arrays)
+        present = tuple(i for i, a in enumerate(arrays) if a is not None)
+        if len(present) == total:
+            return arrays
+        if len(present) < d:
+            raise ErasureError(
+                f"too few shards present: {len(present)} < {d}")
+        limit = d if data_only else total
+        wanted = tuple(i for i in range(limit) if arrays[i] is None)
+        if not wanted:
+            return arrays
+        size = len(arrays[present[0]])
+        key = (d, p, present, wanted, size)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.append((key, arrays, fut))
+        if self._task is None or self._task.done():
+            self._task = asyncio.create_task(self._drain())
+        return await fut
+
+    async def _drain(self) -> None:
+        # Yield once so callers scheduled in the same tick can enqueue
+        # before the first dispatch.
+        await asyncio.sleep(0)
+        while self._pending:
+            pending, self._pending = self._pending, []
+            groups: dict[tuple, list] = {}
+            for item in pending:
+                groups.setdefault(item[0], []).append(item)
+            # Distinct erasure patterns are independent work: dispatch
+            # every group concurrently (a degraded read's random chunk
+            # selection yields varying `present` sets — serializing the
+            # groups would be slower than the unbatched path it replaces).
+            jobs = []
+            for key, items in groups.items():
+                for i in range(0, len(items), self.max_batch):
+                    jobs.append(
+                        self._dispatch(key, items[i:i + self.max_batch]))
+            await asyncio.gather(*jobs)
+
+    async def _dispatch(self, key: tuple, group: list) -> None:
+        try:
+            results = await asyncio.to_thread(
+                self._run_group, key, [g[1] for g in group])
+        except BaseException as err:
+            for _, _, fut in group:
+                if not fut.done():
+                    fut.set_exception(err)
+            if isinstance(err, asyncio.CancelledError):
+                raise
+        else:
+            for (_, _, fut), res in zip(group, results):
+                if not fut.done():
+                    fut.set_result(res)
+
+    def _run_group(self, key: tuple, requests: list[list]) -> list[list]:
+        d, p, present, wanted, size = key
+        self.dispatches += 1
+        coder = get_coder(d, p, self.backend)
+        stacked = np.zeros((len(requests), d + p, size), dtype=np.uint8)
+        for bi, arrays in enumerate(requests):
+            for i in present:
+                row = arrays[i]
+                if len(row) != size:
+                    raise ErasureError("shards must be of equal length")
+                stacked[bi, i] = row
+        rebuilt = coder.reconstruct_batch(stacked, list(present),
+                                          list(wanted))
+        out: list[list] = []
+        for bi, arrays in enumerate(requests):
+            filled = list(arrays)
+            for wi, i in enumerate(wanted):
+                filled[i] = rebuilt[bi, wi]
+            out.append(filled)
+        return out

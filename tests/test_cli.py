@@ -5,6 +5,7 @@ grammar and the standalone shard codec."""
 
 import hashlib
 import os
+import shlex
 import subprocess
 import sys
 
@@ -14,12 +15,22 @@ import yaml
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_cli(*argv, check=True, **kwargs):
+def run_cli(*argv, check=True, pipe_to=None, **kwargs):
+    """Drive ``python -m chunky_bits_tpu.cli``; ``pipe_to`` runs the CLI
+    through a shell pipeline (e.g. "head -c 64 >/dev/null")."""
     env = dict(os.environ)
     env.setdefault("PYTHONPATH", REPO)
+    if pipe_to is None:
+        cmd = [sys.executable, "-m", "chunky_bits_tpu.cli", *argv]
+        shell = False
+    else:
+        cmd = " ".join(
+            shlex.quote(a)
+            for a in (sys.executable, "-m", "chunky_bits_tpu.cli", *argv)
+        ) + " | " + pipe_to
+        shell = True
     result = subprocess.run(
-        [sys.executable, "-m", "chunky_bits_tpu.cli", *argv],
-        capture_output=True, env=env, cwd=REPO, **kwargs)
+        cmd, shell=shell, capture_output=True, env=env, cwd=REPO, **kwargs)
     if check and result.returncode != 0:
         raise AssertionError(
             f"cli failed ({result.returncode}): {result.stderr.decode()}")
@@ -212,3 +223,14 @@ def test_error_paths(cluster_yaml):
     assert b"not defined" in result.stderr or b"Error" in result.stderr
     result = run_cli("resilver", "/tmp/just-a-file", check=False)
     assert result.returncode != 0
+
+
+def test_broken_pipe_quiet(cluster_yaml, tmp_path):
+    """``cat | head`` must not traceback: the CLI dies quietly on SIGPIPE
+    like the reference binary (and every coreutils tool)."""
+    src = tmp_path / "input.bin"
+    src.write_bytes(os.urandom(1 << 20))
+    run_cli("cp", str(src), f"{cluster_yaml}#objects/pipe")
+    proc = run_cli("cat", f"{cluster_yaml}#objects/pipe",
+                   pipe_to="head -c 64 >/dev/null")
+    assert b"Traceback" not in proc.stderr
