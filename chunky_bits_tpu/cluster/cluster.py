@@ -13,6 +13,8 @@ default of 2); that bug is fixed here, matching the behavior of its own
 
 from __future__ import annotations
 
+import asyncio
+import weakref
 from typing import Optional, Union
 
 from chunky_bits_tpu.cluster.destination import Destination
@@ -42,6 +44,9 @@ class Cluster:
         self.metadata = metadata
         self.profiles = profiles
         self.tunables = tunables or Tunables()
+        # per-event-loop shared encode batchers (see _encode_batcher)
+        self._encode_batchers: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
 
     # ---- serde ----
 
@@ -107,9 +112,26 @@ class Cluster:
         cx = self.tunables.location_context().but_with(profiler=profiler)
         return reporter, Destination(self.destinations, profile, cx)
 
+    def _encode_batcher(self):
+        """Per-event-loop shared EncodeHashBatcher so concurrent writes
+        into this cluster (e.g. parallel gateway PUTs of small objects)
+        coalesce into single device dispatches.  Device backends only:
+        the native path's fused zero-copy pass beats an extra memcpy."""
+        if self.tunables.backend != "jax":
+            return None
+        loop = asyncio.get_running_loop()
+        batcher = self._encode_batchers.get(loop)
+        if batcher is None:
+            from chunky_bits_tpu.ops.batching import EncodeHashBatcher
+
+            batcher = EncodeHashBatcher(backend=self.tunables.backend)
+            self._encode_batchers[loop] = batcher
+        return batcher
+
     def get_file_writer(self, profile: ClusterProfile) -> FileWriteBuilder:
         # A device backend amortizes dispatch overhead by staging several
-        # parts into one batched encode (writer.py batch staging).
+        # parts into one batched encode (writer.py batch staging) and by
+        # coalescing across concurrent writes (shared encode batcher).
         batch_parts = 8 if self.tunables.backend == "jax" else 1
         return (
             FileWriteBuilder()
@@ -120,6 +142,7 @@ class Cluster:
             .with_parity_chunks(profile.get_parity_chunks())
             .with_backend(self.tunables.backend)
             .with_batch_parts(batch_parts)
+            .with_encode_batcher(self._encode_batcher)
         )
 
     async def write_file_ref(self, path: str,
@@ -140,12 +163,8 @@ class Cluster:
     ) -> tuple[ProfileReport, FileReference]:
         reporter, destination = self.get_destination_with_profiler(profile)
         file_ref = await (
-            FileWriteBuilder()
+            self.get_file_writer(profile)
             .with_destination(destination)
-            .with_chunk_size(profile.get_chunk_size())
-            .with_data_chunks(profile.get_data_chunks())
-            .with_parity_chunks(profile.get_parity_chunks())
-            .with_backend(self.tunables.backend)
             .write(reader)
         )
         file_ref.content_type = content_type

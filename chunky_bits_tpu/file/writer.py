@@ -39,6 +39,10 @@ class FileWriteBuilder:
     batch_parts: int = 1
     backend: Optional[str] = None
     content_type: Optional[str] = None
+    #: an ops.batching.EncodeHashBatcher shared across concurrent writes
+    #: (coalesces many small files into one device dispatch), or a zero-arg
+    #: callable resolving to one inside the running loop, or None.
+    encode_batcher: object = None
 
     # builder setters (writer.rs:78-110); return copies like the Rust
     # builder's consume-and-return
@@ -68,6 +72,9 @@ class FileWriteBuilder:
                           ) -> "FileWriteBuilder":
         return replace(self, content_type=content_type)
 
+    def with_encode_batcher(self, encode_batcher) -> "FileWriteBuilder":
+        return replace(self, encode_batcher=encode_batcher)
+
     async def write(self, reader: aio.AsyncByteReader) -> FileReference:
         if self.concurrency <= 1:
             raise FileWriteError("concurrency must be > 1")
@@ -83,26 +90,26 @@ class FileWriteBuilder:
         staged: list[tuple[bytes, int]] = []  # (buffer, meaningful length)
         total_bytes = 0
 
-        def encode_staged(items: list[tuple[bytes, int]]):
-            """Encode + hash a batch of parts; same-shard-length stripes
-            share one dispatch (and one fused native encode+hash pass).
-            Runs in a worker thread.
+        encode_batcher = self.encode_batcher
+        if callable(encode_batcher):
+            encode_batcher = encode_batcher()
 
-            Copies each staged part buffer exactly once, into a
-            preallocated [B, d, S] staging array; the shard payloads
-            handed to the writers are zero-copy row views of that array
-            (and of the parity batch), so the ingest path moves each
-            byte host-side only twice: reader -> staging, staging ->
-            destination."""
+        def stage(items: list[tuple[bytes, int]]):
+            """Group staged parts by shard length and copy each part
+            buffer exactly once into a preallocated [B, d, S] staging
+            array per group; the shard payloads later handed to the
+            writers are zero-copy row views of that array (and of the
+            parity batch), so the ingest path moves each byte host-side
+            only twice: reader -> staging, staging -> destination.  Runs
+            in a worker thread."""
             groups: dict[int, list[int]] = {}
             for i, (buf, length) in enumerate(items):
                 shard_len = (length + d - 1) // d
                 groups.setdefault(shard_len, []).append(i)
-            results: dict[int, tuple[list, list, int, Optional[list]]] = {}
+            staged_groups = []
             for shard_len, indices in groups.items():
                 if shard_len == 0:
-                    for i in indices:
-                        results[i] = ([], [], 0, None)
+                    staged_groups.append((0, indices, None))
                     continue
                 stacked = np.empty((len(indices), d, shard_len),
                                    dtype=np.uint8)
@@ -113,7 +120,29 @@ class FileWriteBuilder:
                                                   count=length)
                     if length < d * shard_len:
                         flat[length:] = 0
-                parity_batch, digest_batch = coder.encode_hash_batch(stacked)
+                staged_groups.append((shard_len, indices, stacked))
+            return staged_groups
+
+        async def encode_staged(items: list[tuple[bytes, int]]):
+            """Encode + hash a batch of parts; same-shard-length stripes
+            share one dispatch (and one fused native encode+hash pass).
+            With a shared encode batcher, the dispatch additionally
+            coalesces with other concurrent writes (many-small-files /
+            gateway ingest)."""
+            groups = await asyncio.to_thread(stage, items)
+            results: dict[int, tuple[list, list, int, Optional[list]]] = {}
+
+            async def encode_group(shard_len, indices, stacked):
+                if shard_len == 0:
+                    for i in indices:
+                        results[i] = ([], [], 0, None)
+                    return
+                if encode_batcher is not None:
+                    parity_batch, digest_batch = \
+                        await encode_batcher.encode_hash(d, p, stacked)
+                else:
+                    parity_batch, digest_batch = await asyncio.to_thread(
+                        coder.encode_hash_batch, stacked)
                 for bi, i in enumerate(indices):
                     results[i] = (
                         list(stacked[bi]),
@@ -121,6 +150,8 @@ class FileWriteBuilder:
                         shard_len,
                         [row.tobytes() for row in digest_batch[bi]],
                     )
+
+            await asyncio.gather(*(encode_group(*g) for g in groups))
             return [results[i] for i in range(len(items))]
 
         async def write_part(precomputed) -> FilePart:
@@ -135,7 +166,7 @@ class FileWriteBuilder:
 
         async def run_batch(items) -> list[FilePart]:
             try:
-                pre = await asyncio.to_thread(encode_staged, items)
+                pre = await encode_staged(items)
             except BaseException:
                 for _ in items:
                     sem.release()

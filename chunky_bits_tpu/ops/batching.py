@@ -1,19 +1,18 @@
-"""Coalesce concurrent Reed-Solomon reconstructions into batched dispatches.
+"""Coalesce concurrent erasure-codec calls into batched device dispatches.
 
-The reference rebuilds one part at a time on the blocking pool
-(src/file/file_part.rs:128,302-305).  That shape wastes a TPU: resilver
-keeps 10 parts in flight (src/file/file_reference.rs:110), a degraded read
-prefetches 5 (src/file/reader.rs:96), and the parts of one file almost
-always share an erasure pattern — the node that lost shard *i* of one part
-lost shard *i* of every part.  The batcher collects whatever reconstruction
-requests are in flight at the same moment, groups them by (geometry,
-erasure pattern, shard length, data-only), and rebuilds each group in a
-single ``[B, d+p, S]`` dispatch through ``ErasureCoder.reconstruct_batch``
-— one device call (or one threaded native call) instead of B.
+The reference runs one codec call per part on the blocking pool — encode at
+src/file/file_part.rs:161-165, reconstruct at :128,302-305.  That shape
+wastes a TPU: dispatch overhead dominates small calls, while the kernel
+itself is throughput-bound and loves batch.  Concurrency that already
+exists in the pipelines (resilver keeps 10 parts in flight
+src/file/file_reference.rs:110, reads prefetch 5 src/file/reader.rs:96,
+the gateway serves many PUTs at once) is turned into batch here: whatever
+requests are in flight at the same moment are grouped by compatible shape
+and executed as one ``[B, ...]`` dispatch.
 
 Requests that arrive while a dispatch is running accumulate and form the
-next batch, so batching emerges from concurrency without added latency:
-a lone request is dispatched immediately.
+next batch, so batching emerges from concurrency without added latency: a
+lone request is dispatched immediately.
 """
 
 from __future__ import annotations
@@ -27,19 +26,75 @@ from chunky_bits_tpu.errors import ErasureError
 from chunky_bits_tpu.ops.backend import get_coder
 
 
-class ReconstructBatcher:
-    """Shared per-pipeline reconstruction front-end.
+class _CoalescingBatcher:
+    """Group concurrent requests by key and dispatch each group once.
 
-    One instance is created per read stream / resilver run and passed down
-    to the parts; it must be used from a single event loop.
+    Instances are per-pipeline (one read stream, one resilver run, one
+    cluster ingest scope) and must be used from a single event loop.
+    Subclasses implement ``_run_group(key, payloads) -> results`` (called
+    in a worker thread).
     """
 
     def __init__(self, backend: Optional[str] = None, max_batch: int = 128):
         self.backend = backend
         self.max_batch = max_batch
-        self._pending: list[tuple[tuple, list, asyncio.Future]] = []
+        self._pending: list[tuple[tuple, object, asyncio.Future]] = []
         self._task: Optional[asyncio.Task] = None
+        self._inflight: set[asyncio.Task] = set()
         self.dispatches = 0  # observability + tests
+
+    async def _submit(self, key: tuple, payload):
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.append((key, payload, fut))
+        if self._task is None or self._task.done():
+            self._task = asyncio.create_task(self._drain())
+        return await fut
+
+    async def _drain(self) -> None:
+        # Yield once so callers scheduled in the same tick can enqueue
+        # before the first dispatch.
+        await asyncio.sleep(0)
+        pending, self._pending = self._pending, []
+        groups: dict[tuple, list] = {}
+        for item in pending:
+            groups.setdefault(item[0], []).append(item)
+        # Distinct keys are independent work, and nothing waits on anyone
+        # else's group: each dispatch is fired as its own task (no barrier
+        # — a slow group must not stall either the other groups' results
+        # or the next round of arrivals, which simply start a new drain).
+        for key, items in groups.items():
+            for i in range(0, len(items), self.max_batch):
+                task = asyncio.create_task(
+                    self._dispatch(key, items[i:i + self.max_batch]))
+                self._inflight.add(task)
+                task.add_done_callback(self._inflight.discard)
+
+    async def _dispatch(self, key: tuple, group: list) -> None:
+        try:
+            results = await asyncio.to_thread(
+                self._run_group, key, [g[1] for g in group])
+        except BaseException as err:
+            for _, _, fut in group:
+                if not fut.done():
+                    fut.set_exception(err)
+            if isinstance(err, asyncio.CancelledError):
+                raise
+        else:
+            for (_, _, fut), res in zip(group, results):
+                if not fut.done():
+                    fut.set_result(res)
+
+    def _run_group(self, key: tuple, payloads: list) -> list:
+        raise NotImplementedError
+
+
+class ReconstructBatcher(_CoalescingBatcher):
+    """Batched decode front-end for the read and resilver paths.
+
+    Groups by (geometry, erasure pattern, shard length): the parts of one
+    file degraded by the same node loss share a pattern and rebuild in one
+    ``[B, d+p, S]`` dispatch through ``ErasureCoder.reconstruct_batch``.
+    """
 
     async def reconstruct(
         self, d: int, p: int, arrays: Sequence[Optional[np.ndarray]],
@@ -65,59 +120,23 @@ class ReconstructBatcher:
         if not wanted:
             return arrays
         size = len(arrays[present[0]])
+        # Validate before coalescing: a malformed request must fail alone,
+        # not poison the whole group it would have joined.
+        for i in present[1:]:
+            if len(arrays[i]) != size:
+                raise ErasureError("shards must be of equal length")
         key = (d, p, present, wanted, size)
-        fut = asyncio.get_running_loop().create_future()
-        self._pending.append((key, arrays, fut))
-        if self._task is None or self._task.done():
-            self._task = asyncio.create_task(self._drain())
-        return await fut
-
-    async def _drain(self) -> None:
-        # Yield once so callers scheduled in the same tick can enqueue
-        # before the first dispatch.
-        await asyncio.sleep(0)
-        while self._pending:
-            pending, self._pending = self._pending, []
-            groups: dict[tuple, list] = {}
-            for item in pending:
-                groups.setdefault(item[0], []).append(item)
-            # Distinct erasure patterns are independent work: dispatch
-            # every group concurrently (a degraded read's random chunk
-            # selection yields varying `present` sets — serializing the
-            # groups would be slower than the unbatched path it replaces).
-            jobs = []
-            for key, items in groups.items():
-                for i in range(0, len(items), self.max_batch):
-                    jobs.append(
-                        self._dispatch(key, items[i:i + self.max_batch]))
-            await asyncio.gather(*jobs)
-
-    async def _dispatch(self, key: tuple, group: list) -> None:
-        try:
-            results = await asyncio.to_thread(
-                self._run_group, key, [g[1] for g in group])
-        except BaseException as err:
-            for _, _, fut in group:
-                if not fut.done():
-                    fut.set_exception(err)
-            if isinstance(err, asyncio.CancelledError):
-                raise
-        else:
-            for (_, _, fut), res in zip(group, results):
-                if not fut.done():
-                    fut.set_result(res)
+        return await self._submit(key, arrays)
 
     def _run_group(self, key: tuple, requests: list[list]) -> list[list]:
         d, p, present, wanted, size = key
         self.dispatches += 1
         coder = get_coder(d, p, self.backend)
-        stacked = np.zeros((len(requests), d + p, size), dtype=np.uint8)
+        # empty, not zeros: reconstruct_batch reads only present[:d] rows
+        stacked = np.empty((len(requests), d + p, size), dtype=np.uint8)
         for bi, arrays in enumerate(requests):
             for i in present:
-                row = arrays[i]
-                if len(row) != size:
-                    raise ErasureError("shards must be of equal length")
-                stacked[bi, i] = row
+                stacked[bi, i] = arrays[i]
         rebuilt = coder.reconstruct_batch(stacked, list(present),
                                           list(wanted))
         out: list[list] = []
@@ -126,4 +145,54 @@ class ReconstructBatcher:
             for wi, i in enumerate(wanted):
                 filled[i] = rebuilt[bi, wi]
             out.append(filled)
+        return out
+
+
+class EncodeHashBatcher(_CoalescingBatcher):
+    """Batched encode+hash front-end for the ingest path.
+
+    One large file already batches its own parts (writer.py staging); this
+    batcher coalesces *across* concurrent writes — the many-small-objects
+    regime (e.g. parallel HTTP-gateway PUTs), where each write has a
+    single sub-batch part and per-dispatch overhead would dominate.
+    Grouped by (d, p, shard length); payload batches are concatenated into
+    one ``[ΣB, d, S]`` ``encode_hash_batch`` call.
+
+    The concatenation copies each staged batch once more host-side, which
+    is why the cluster engages this only for device backends (the native
+    path keeps its zero-copy fused pass — an extra memcpy would cost more
+    than the per-call overhead it saves).
+    """
+
+    async def encode_hash(
+        self, d: int, p: int, stacked: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Async equivalent of ``ErasureCoder.encode_hash_batch`` for one
+        staged part batch ``stacked[B, d, S]``: returns
+        ``(parity[B, p, S], digests[B, d+p, 32])``."""
+        if stacked.ndim != 3 or stacked.shape[1] != d:
+            raise ErasureError(
+                f"expected stacked [B, {d}, S], got {stacked.shape}")
+        b, _, size = stacked.shape
+        if b == 0:
+            return (np.zeros((0, p, size), dtype=np.uint8),
+                    np.zeros((0, d + p, 32), dtype=np.uint8))
+        key = (d, p, size)
+        return await self._submit(key, stacked)
+
+    def _run_group(self, key: tuple, batches: list[np.ndarray]) -> list:
+        d, p, _size = key
+        self.dispatches += 1
+        coder = get_coder(d, p, self.backend)
+        if len(batches) == 1:
+            merged = batches[0]
+        else:
+            merged = np.concatenate(batches, axis=0)
+        parity, digests = coder.encode_hash_batch(merged)
+        out = []
+        lo = 0
+        for batch in batches:
+            hi = lo + batch.shape[0]
+            out.append((parity[lo:hi], digests[lo:hi]))
+            lo = hi
         return out
