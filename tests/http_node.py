@@ -11,10 +11,13 @@ from aiohttp import web
 
 
 class FakeHttpNode:
-    def __init__(self) -> None:
+    def __init__(self, fail_puts: bool = False) -> None:
         self.store: dict[str, bytes] = {}
         self._runner = None
         self.port: int = 0
+        #: node-wide broken-disk mode: every PUT returns 507
+        self.fail_puts = fail_puts
+        self.put_attempts = 0
 
     @property
     def url(self) -> str:
@@ -46,7 +49,9 @@ class FakeHttpNode:
 
     async def _put(self, request: web.Request) -> web.Response:
         key = request.match_info["key"]
-        if key.startswith("fail/"):  # simulated full/broken disk
+        self.put_attempts += 1
+        if self.fail_puts or key.startswith("fail/"):
+            # simulated full/broken disk
             return web.Response(status=507)
         self.store[key] = await request.read()
         return web.Response()

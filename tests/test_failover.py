@@ -1,0 +1,139 @@
+"""Cluster-level write failover: node invalidation, redraw, exhaustion.
+
+The reference's per-shard retry engine (src/cluster/writer.rs:99-122,
+254-276) invalidates a node on write failure, relaxes zone budgets and
+draws a new node until success or NotEnoughAvailability.  The reference
+repo never tests this path; these tests inject real failing HTTP nodes
+(507 on every PUT) into a mixed cluster.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from chunky_bits_tpu.cluster import Cluster
+from chunky_bits_tpu.errors import FileWriteError, NotEnoughAvailability
+from chunky_bits_tpu.utils import aio
+
+from tests.http_node import FakeHttpNode
+
+
+def _cluster_obj(locations, meta_path, d=3, p=2, zones=None):
+    dests = []
+    for i, loc in enumerate(locations):
+        node = {"location": loc}
+        if zones:
+            node["zones"] = zones[i]
+        dests.append(node)
+    return {
+        "destinations": dests,
+        "metadata": {"type": "path", "format": "yaml",
+                     "path": str(meta_path)},
+        "profiles": {"default": {"data": d, "parity": p, "chunk_size": 12}},
+    }
+
+
+def _payload(n=30000, seed=21):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+def test_write_fails_over_broken_node(tmp_path):
+    """One dead node in a six-node cluster: writes succeed, every shard
+    lands on a healthy node, and the dead node saw at least one attempt
+    (proving failover, not avoidance)."""
+
+    async def main():
+        bad = await FakeHttpNode(fail_puts=True).start()
+        good_dirs = []
+        for i in range(5):
+            d = tmp_path / f"disk{i}"
+            d.mkdir()
+            good_dirs.append(str(d))
+        try:
+            meta = tmp_path / "meta"
+            meta.mkdir()
+            cluster = Cluster.from_obj(
+                _cluster_obj([bad.url + "/"] + good_dirs, meta))
+            payload = _payload()
+            ref = await cluster.write_file(
+                "x", aio.BytesReader(payload), cluster.get_profile())
+            assert bad.put_attempts > 0, "dead node was never attempted"
+            for part in ref.parts:
+                for chunk in part.data + part.parity:
+                    for loc in chunk.locations:
+                        assert not str(loc).startswith("http"), \
+                            f"shard on dead node: {loc}"
+            got = await (await cluster.get_file_ref("x")) \
+                .read_builder().read_all()
+            assert got == payload
+        finally:
+            await bad.stop()
+
+    asyncio.run(main())
+
+
+def test_write_exhaustion_raises(tmp_path):
+    """d+p=5 with only 4 healthy slots: the retry loop must exhaust and
+    surface an error, not hang or silently drop a shard."""
+
+    async def main():
+        bad = await FakeHttpNode(fail_puts=True).start()
+        bad2 = await FakeHttpNode(fail_puts=True).start()
+        good_dirs = []
+        for i in range(3):
+            d = tmp_path / f"disk{i}"
+            d.mkdir()
+            good_dirs.append(str(d))
+        try:
+            meta = tmp_path / "meta"
+            meta.mkdir()
+            cluster = Cluster.from_obj(_cluster_obj(
+                [bad.url + "/", bad2.url + "/"] + good_dirs, meta))
+            with pytest.raises((FileWriteError, NotEnoughAvailability)):
+                await cluster.write_file(
+                    "x", aio.BytesReader(_payload()),
+                    cluster.get_profile())
+        finally:
+            await bad.stop()
+            await bad2.stop()
+
+    asyncio.run(main())
+
+
+def test_failover_respects_zones_then_relaxes(tmp_path):
+    """Ideal-zone budgets steer placement, but when the ideal zone's node
+    dies mid-write the budget relaxes and the shard lands in the other
+    zone rather than failing the write (writer.rs:99-122)."""
+
+    async def main():
+        bad = await FakeHttpNode(fail_puts=True).start()
+        good_dirs = []
+        for i in range(5):
+            d = tmp_path / f"disk{i}"
+            d.mkdir()
+            good_dirs.append(str(d))
+        try:
+            meta = tmp_path / "meta"
+            meta.mkdir()
+            obj = _cluster_obj(
+                [bad.url + "/"] + good_dirs, meta,
+                zones=[["ssd"]] + [["hdd"]] * 5,
+            )
+            obj["profiles"]["default"]["rules"] = {
+                "ssd": {"ideal": 1},
+            }
+            cluster = Cluster.from_obj(obj)
+            payload = _payload(20000, seed=3)
+            ref = await cluster.write_file(
+                "x", aio.BytesReader(payload), cluster.get_profile())
+            assert bad.put_attempts > 0, \
+                "ideal-zone node was never attempted"
+            got = await (await cluster.get_file_ref("x")) \
+                .read_builder().read_all()
+            assert got == payload
+        finally:
+            await bad.stop()
+
+    asyncio.run(main())
