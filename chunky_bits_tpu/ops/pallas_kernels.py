@@ -17,8 +17,12 @@ Kernel math (per grid cell, shapes static):
     out [R, TS]   = pack(acc & 1)                (VPU shifts/ors)
 
 The matmul runs on the int8 MXU path (v5e executes int8 at 2x the bf16
-rate, and the int8 bit-planes halve VMEM traffic vs bf16): measured
-~73 GiB/s sustained vs ~57 GiB/s for the bf16 variant at d=10 p=4.
+rate, and the int8 bit-planes halve VMEM traffic vs bf16).  Hoist-proof
+marginal measurement (bench.py method) on one v5e chip at d=10 p=4,
+1 MiB chunks, batch 128: ~52-57 GiB/s sustained, ~10% above the bf16
+variant.  Variants tried and rejected as slower on-chip: packed-word
+unpack via sublane bitcast (~53), Kronecker-segmented matmul filling the
+MXU M dimension (~53); the kernel sits at a genuine local optimum.
 Accumulation is exact — each dot sums at most K8 ones, far below 2^31.
 """
 
