@@ -166,6 +166,44 @@ class _HttpBodyReader:
             self._resp = None
 
 
+class _ProfiledReader:
+    """Counts streamed bytes and emits exactly one profiler entry when
+    the stream ends — EOF, error, or early close (the reference's
+    streaming paths are unprofiled, ``// TODO: Profiler``
+    src/file/location.rs:119,255)."""
+
+    def __init__(self, base, profiler: Profiler, location: "Location",
+                 start: float):
+        self._base = base
+        self._profiler = profiler
+        self._location = location
+        self._start = start
+        self._total = 0
+        self._logged = False
+
+    def _log(self, ok: bool, err: Optional[str] = None) -> None:
+        if not self._logged:
+            self._logged = True
+            self._profiler.log_read(ok, err, self._location, self._total,
+                                    self._start)
+
+    async def read(self, n: int = -1) -> bytes:
+        try:
+            data = await self._base.read(n)
+        except Exception as err:
+            self._log(False, str(err))
+            raise
+        if data:
+            self._total += len(data)
+        else:
+            self._log(True)
+        return data
+
+    async def close(self) -> None:
+        self._log(True)
+        await aio.close_reader(self._base)
+
+
 @dataclass(frozen=True, order=True)
 class Location:
     """A storage address; value semantics, string serde."""
@@ -253,8 +291,22 @@ class Location:
     async def reader(self, cx: Optional[LocationContext] = None
                      ) -> aio.AsyncByteReader:
         """Open a streaming reader honoring the range
-        (src/file/location.rs:115-183)."""
+        (src/file/location.rs:115-183).  Profiler-hooked: one entry per
+        stream at EOF/close/error — the streaming-path hook the reference
+        leaves as TODO (src/file/location.rs:119)."""
         cx = cx or default_context()
+        if cx.profiler is None:
+            return await self._open_reader(cx)
+        start = time.monotonic()
+        try:
+            base = await self._open_reader(cx)
+        except LocationError as err:
+            cx.profiler.log_read(False, str(err), self, 0, start)
+            raise
+        return _ProfiledReader(base, cx.profiler, self, start)
+
+    async def _open_reader(self, cx: LocationContext
+                           ) -> aio.AsyncByteReader:
         rng = self.range
         if self.is_local():
             try:
@@ -304,7 +356,9 @@ class Location:
         cx = cx or default_context()
         start = time.monotonic()
         try:
-            reader = await self.reader(cx)
+            # _open_reader, not reader(): this whole-buffer op logs its own
+            # single profiler entry below.
+            reader = await self._open_reader(cx)
             chunks = []
             while True:
                 data = await reader.read(1 << 20)
@@ -363,8 +417,23 @@ class Location:
     async def write_from_reader(self, reader: aio.AsyncByteReader,
                                 cx: Optional[LocationContext] = None) -> int:
         """Streaming write; 1 MiB chunks into a chunked HTTP PUT or a local
-        file (src/file/location.rs:246-309).  Returns bytes written."""
+        file (src/file/location.rs:246-309).  Returns bytes written.
+        Profiler-hooked (the reference's TODO at location.rs:255)."""
         cx = cx or default_context()
+        start = time.monotonic()
+        total = 0
+        try:
+            total = await self._write_from_reader_impl(reader, cx)
+        except LocationError as err:
+            if cx.profiler is not None:
+                cx.profiler.log_write(False, str(err), self, total, start)
+            raise
+        if cx.profiler is not None:
+            cx.profiler.log_write(True, None, self, total, start)
+        return total
+
+    async def _write_from_reader_impl(self, reader: aio.AsyncByteReader,
+                                      cx: LocationContext) -> int:
         if self.range.is_specified():
             raise WriteToRangeError()
         if cx.on_conflict == IGNORE and await self.file_exists(cx):

@@ -242,3 +242,54 @@ def test_http_missing_404():
             await node.stop()
 
     asyncio.run(main())
+
+
+def test_streaming_profiler_hooks(tmp_path):
+    """Streaming reader/writer paths emit one profiler entry per stream —
+    the hooks the reference leaves as TODO (src/file/location.rs:119,255)."""
+    from chunky_bits_tpu.file.profiler import new_profiler
+    from chunky_bits_tpu.utils import aio as aio_utils
+
+    payload = os.urandom(100000)
+    src = tmp_path / "src.bin"
+    src.write_bytes(payload)
+
+    async def main():
+        profiler, reporter = new_profiler()
+        cx = LocationContext(profiler=profiler)
+
+        # streaming read to EOF: one successful entry, full byte count
+        reader = await Location.parse(str(src)).reader(cx)
+        total = 0
+        while True:
+            data = await reader.read(8192)
+            if not data:
+                break
+            total += len(data)
+        assert total == len(payload)
+
+        # early close: entry logged with partial count, not dropped
+        reader = await Location.parse(str(src)).reader(cx)
+        first = await reader.read(4096)
+        await aio_utils.close_reader(reader)
+        assert len(first) == 4096
+
+        # streaming write: one successful write entry
+        dst = Location.parse(str(tmp_path / "dst.bin"))
+        await dst.write_from_reader(aio_utils.BytesReader(payload), cx)
+
+        # open failure logs a failed read entry
+        with pytest.raises(LocationError):
+            await Location.parse(str(tmp_path / "missing.bin")).reader(cx)
+
+        report = reporter.profile()
+        reads = [e for e in report.entries if e.kind == "read"]
+        writes = [e for e in report.entries if e.kind == "write"]
+        assert len(reads) == 3
+        assert [e.ok for e in reads] == [True, True, False]
+        assert reads[0].length == len(payload)
+        assert reads[1].length == 4096
+        assert len(writes) == 1
+        assert writes[0].ok and writes[0].length == len(payload)
+
+    asyncio.run(main())
