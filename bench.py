@@ -236,7 +236,13 @@ if __name__ == "__main__":
     # Default (no args): BASELINE config 2/3 on the device — the driver's
     # recorded metric.  --config 1|4 run the auxiliary BASELINE.md configs.
     if "--config" in sys.argv:
-        which = sys.argv[sys.argv.index("--config") + 1]
-        {"1": bench_cpu_reference, "4": bench_small_objects}[which]()
+        configs = {"1": bench_cpu_reference, "4": bench_small_objects}
+        idx = sys.argv.index("--config") + 1
+        which = sys.argv[idx] if idx < len(sys.argv) else ""
+        if which not in configs:
+            print(f"usage: bench.py [--config {{1,4}}] — configs 2/3 are "
+                  f"the default no-arg run (got {which!r})", file=sys.stderr)
+            sys.exit(2)
+        configs[which]()
     else:
         main()

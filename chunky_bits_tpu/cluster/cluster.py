@@ -117,7 +117,8 @@ class Cluster:
         into this cluster (e.g. parallel gateway PUTs of small objects)
         coalesce into single device dispatches.  Device backends only:
         the native path's fused zero-copy pass beats an extra memcpy."""
-        if self.tunables.backend != "jax":
+        backend = self.tunables.backend or ""
+        if not backend.startswith("jax"):
             return None
         loop = asyncio.get_running_loop()
         batcher = self._encode_batchers.get(loop)
@@ -132,7 +133,8 @@ class Cluster:
         # A device backend amortizes dispatch overhead by staging several
         # parts into one batched encode (writer.py batch staging) and by
         # coalescing across concurrent writes (shared encode batcher).
-        batch_parts = 8 if self.tunables.backend == "jax" else 1
+        batch_parts = 8 if (self.tunables.backend or "").startswith(
+            "jax") else 1
         return (
             FileWriteBuilder()
             .with_destination(self.get_destination(profile))

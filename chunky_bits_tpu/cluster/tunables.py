@@ -5,6 +5,9 @@ Mirrors src/cluster/tunables.rs:52-95: ``https_only`` (default false),
 existing file with the right name is already correct), ``user_agent``, plus
 the erasure ``backend`` selection (this framework's addition — the
 north-star's cluster.yaml switch between cpu and TPU erasure backends).
+
+``backend`` names: ``numpy`` / ``native`` / ``jax`` (single device) /
+``jax:dp4,sp2`` / ``jax:tp4`` (device-mesh sharded; parallel/backend.py).
 """
 
 from __future__ import annotations

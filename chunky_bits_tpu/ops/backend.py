@@ -106,6 +106,18 @@ def get_backend(name: Optional[str] = None) -> ErasureBackend:
         except Exception as err:  # e.g. no usable jax device/platform
             raise ErasureError(
                 f"jax erasure backend unavailable: {err}") from err
+    elif name.startswith("jax:"):
+        # mesh-sharded device backend, e.g. "jax:dp4,sp2" / "jax:tp4"
+        # (parallel/backend.py)
+        from chunky_bits_tpu.parallel.backend import MeshJaxBackend
+
+        try:
+            backend = MeshJaxBackend(name[len("jax:"):])
+        except ErasureError:
+            raise
+        except Exception as err:
+            raise ErasureError(
+                f"mesh jax backend {name!r} unavailable: {err}") from err
     elif name == "auto":
         try:
             from chunky_bits_tpu.ops.cpu_backend import NativeBackend

@@ -1,0 +1,95 @@
+"""Mesh-sharded erasure backend, selectable from cluster.yaml.
+
+Bridges the multi-chip compute plane (parallel/mesh.py) into the ordinary
+``ErasureBackend`` string plumbing, so a cluster definition can put its
+erasure math on a device mesh the same way it selects ``jax``
+(tunables, reference analogue src/cluster/tunables.rs):
+
+    tunables:
+      backend: jax:dp4,sp2    # part batch over 4 chips, shard bytes over 2
+      # or
+      backend: jax:tp4        # wide stripes: GF contraction over 4 chips
+
+Axes: ``dp`` splits the part batch, ``sp`` splits shard bytes, ``tp``
+splits the stripe (contraction) axis with an integer psum over ICI
+(mesh.py).  ``tp`` and ``sp`` are mutually exclusive (the wide path's
+mesh is ('dp','tp')); unspecified axes default so the product covers all
+visible devices.  Batch and byte axes that don't divide evenly are
+zero-padded for the dispatch and sliced back — GF transforms are
+columnwise, so padding never leaks into real output.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from chunky_bits_tpu.errors import ErasureError
+from chunky_bits_tpu.ops.backend import ErasureBackend
+
+
+def parse_mesh_spec(spec: str) -> dict[str, int]:
+    """Parse ``"dp4,sp2"`` → {"dp": 4, "sp": 2}.  Axes: dp, sp, tp."""
+    axes: dict[str, int] = {}
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, _, num = item.partition("=") if "=" in item else (
+            item[:2], "", item[2:])
+        if name not in ("dp", "sp", "tp") or not num.isdigit() \
+                or int(num) < 1:
+            raise ErasureError(f"bad mesh axis {item!r} in {spec!r} "
+                               f"(want e.g. jax:dp4,sp2 or jax:tp4)")
+        if name in axes:
+            raise ErasureError(f"duplicate mesh axis {name!r} in {spec!r}")
+        axes[name] = int(num)
+    if "tp" in axes and "sp" in axes:
+        raise ErasureError("mesh axes tp and sp are mutually exclusive "
+                           "(wide stripes shard bytes via dp instead)")
+    if not axes:
+        raise ErasureError(f"empty mesh spec {spec!r}")
+    return axes
+
+
+class MeshJaxBackend(ErasureBackend):
+    """GF(2^8) matrix application sharded over a device mesh."""
+
+    def __init__(self, spec: str):
+        from chunky_bits_tpu.parallel import mesh as mesh_mod
+
+        self.name = f"jax:{spec}"
+        axes = parse_mesh_spec(spec)
+        import jax
+
+        n = len(jax.devices())
+        self._wide = "tp" in axes
+        if self._wide:
+            tp = axes["tp"]
+            dp = axes.get("dp", max(n // tp, 1))
+            self.mesh = mesh_mod.make_stripe_mesh(dp * tp, dp=dp, tp=tp)
+            self._apply = mesh_mod.wide_apply_sharded
+            self.dp, self.minor = dp, tp
+        else:
+            dp, sp = axes.get("dp"), axes.get("sp")
+            n_dev = dp * sp if (dp and sp) else None
+            self.mesh = mesh_mod.make_mesh(n_dev, dp=dp, sp=sp)
+            self._apply = mesh_mod.sharded_apply
+            self.dp = self.mesh.shape["dp"]
+            self.minor = self.mesh.shape["sp"]
+
+    def apply_matrix(self, mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        b, k, s = shards.shape
+        r = mat.shape[0]
+        if r == 0 or b == 0:
+            return np.zeros((b, r, s), dtype=np.uint8)
+        if self._wide and k % self.minor != 0:
+            raise ErasureError(
+                f"stripe width {k} not divisible by tp={self.minor}")
+        pad_b = (-b) % self.dp
+        pad_s = 0 if self._wide else (-s) % self.minor
+        if pad_b or pad_s:
+            shards = np.pad(shards, ((0, pad_b), (0, 0), (0, pad_s)))
+        out = np.asarray(self._apply(self.mesh, mat, shards))
+        if pad_b or pad_s:
+            out = out[:b, :, :s]
+        return np.ascontiguousarray(out)
