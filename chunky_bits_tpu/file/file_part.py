@@ -70,6 +70,41 @@ class FileIntegrity(enum.IntEnum):
         return self.name.capitalize()
 
 
+_FUSED_HASHER = None  # resolved once: sha256_file or False
+
+
+async def _hash_local_fused(chunk, location, cx):
+    """Digest of a local chunk file via the native streaming read+hash
+    pass (C++ SHA-NI; ops/cpu_backend.sha256_file), which never surfaces
+    the bytes to Python.  Returns None when the fast path doesn't apply —
+    non-local / extend-zeros-range locations, non-sha256 hashes, an
+    active profiler (which must see the generic read), a missing native
+    build, or any I/O failure (the generic path re-reads and reports the
+    error in its own words)."""
+    global _FUSED_HASHER
+    if (cx.profiler is not None or not location.is_local()
+            or location.range.extend_zeros
+            or chunk.hash.algorithm != "sha256"):
+        return None
+    if _FUSED_HASHER is None:
+        try:
+            from chunky_bits_tpu.ops.cpu_backend import (sha256_buf,
+                                                         sha256_file)
+
+            await asyncio.to_thread(sha256_buf, b"")  # force deferred build
+            _FUSED_HASHER = sha256_file
+        except Exception:
+            _FUSED_HASHER = False
+    if _FUSED_HASHER is False:
+        return None
+    try:
+        return await asyncio.to_thread(
+            _FUSED_HASHER, location.target,
+            location.range.start or 0, location.range.length)
+    except OSError:
+        return None
+
+
 async def _reconstruct(arrays, d: int, p: int,
                        coder: Optional[ErasureCoder], backend: Optional[str],
                        batcher, data_only: bool):
@@ -281,6 +316,9 @@ class FilePart:
         cx = cx or default_context()
 
         async def check(ci: int, chunk: Chunk, li: int, location: Location):
+            digest = await _hash_local_fused(chunk, location, cx)
+            if digest is not None:
+                return (ci, li, digest == chunk.hash.value.digest, None)
             try:
                 data = await location.read(cx)
             except LocationError as err:

@@ -18,6 +18,7 @@
 
 #include <cstddef>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <thread>
 #include <vector>
@@ -249,19 +250,16 @@ TransformFn pick_transform() {
 
 const TransformFn kTransform = pick_transform();
 
-void digest(const uint8_t* data, size_t len, uint8_t out[32]) {
-    uint32_t st[8];
-    std::memcpy(st, H0, sizeof(st));
-    size_t blocks = len / 64;
-    kTransform(st, data, blocks);
-    // final 1-2 blocks: remainder + 0x80 pad + 64-bit big-endian bit length
+// Pad/finalize: absorb the trailing `rem` bytes (rem < 64) plus the
+// 0x80 pad and 64-bit big-endian bit length, then emit the digest.
+void finalize(uint32_t st[8], const uint8_t* partial, size_t rem,
+              uint64_t total_len, uint8_t out[32]) {
     uint8_t tail[128];
-    size_t rem = len - blocks * 64;
-    std::memcpy(tail, data + blocks * 64, rem);
+    std::memcpy(tail, partial, rem);
     tail[rem] = 0x80;
     size_t tail_len = rem + 1 <= 56 ? 64 : 128;
     std::memset(tail + rem + 1, 0, tail_len - rem - 1 - 8);
-    uint64_t bits = uint64_t(len) * 8;
+    uint64_t bits = total_len * 8;
     for (int i = 0; i < 8; i++) {
         tail[tail_len - 1 - i] = uint8_t(bits >> (8 * i));
     }
@@ -272,6 +270,62 @@ void digest(const uint8_t* data, size_t len, uint8_t out[32]) {
         out[4 * i + 2] = uint8_t(st[i] >> 8);
         out[4 * i + 3] = uint8_t(st[i]);
     }
+}
+
+void digest(const uint8_t* data, size_t len, uint8_t out[32]) {
+    uint32_t st[8];
+    std::memcpy(st, H0, sizeof(st));
+    size_t blocks = len / 64;
+    kTransform(st, data, blocks);
+    finalize(st, data + blocks * 64, len - blocks * 64, uint64_t(len), out);
+}
+
+// Streaming SHA-256 over a file byte range without surfacing the bytes
+// to the caller — the read+hash fusion for local chunk verification
+// (verify reads every location of every chunk, reference
+// src/file/file_part.rs:228-251).  `want` = UINT64_MAX hashes to EOF.
+// Returns 0 ok, -1 open/read error, -2 file shorter than start+want.
+int digest_file(const char* path, uint64_t start, uint64_t want,
+                uint8_t out[32]) {
+    std::FILE* f = std::fopen(path, "rb");
+    if (!f) return -1;
+    if (start != 0 && fseeko(f, static_cast<off_t>(start),
+                             SEEK_SET) != 0) {
+        std::fclose(f);
+        return -1;
+    }
+    uint32_t st[8];
+    std::memcpy(st, H0, sizeof(st));
+    std::vector<uint8_t> buf(1 << 20);
+    size_t rem = 0;  // partial block carried at buf[0..rem)
+    uint64_t total = 0;
+    const bool to_eof = want == UINT64_MAX;
+    while (true) {
+        size_t cap = buf.size() - rem;
+        if (!to_eof) {
+            uint64_t left = want - total;
+            if (left < cap) cap = static_cast<size_t>(left);
+        }
+        if (cap == 0) break;
+        size_t n = std::fread(buf.data() + rem, 1, cap, f);
+        if (n == 0) {
+            if (std::ferror(f)) {
+                std::fclose(f);
+                return -1;
+            }
+            break;  // EOF
+        }
+        total += n;
+        size_t have = rem + n;
+        size_t blocks = have / 64;
+        kTransform(st, buf.data(), blocks);
+        rem = have - blocks * 64;
+        std::memmove(buf.data(), buf.data() + blocks * 64, rem);
+    }
+    std::fclose(f);
+    if (!to_eof && total != want) return -2;
+    finalize(st, buf.data(), rem, total, out);
+    return 0;
 }
 
 }  // namespace sha256
@@ -319,6 +373,13 @@ uint8_t cb_gf_mul(uint8_t a, uint8_t b) { return MUL[a][b]; }
 // SHA-256 of one buffer (SHA-NI when available).
 void cb_sha256(const uint8_t* data, size_t len, uint8_t* out) {
     sha256::digest(data, len, out);
+}
+
+// SHA-256 of a file byte range; len = UINT64_MAX hashes start..EOF.
+// 0 ok, -1 I/O error, -2 short file.
+int cb_sha256_file(const char* path, uint64_t start, uint64_t len,
+                   uint8_t* out) {
+    return sha256::digest_file(path, start, len, out);
 }
 
 // 1 when the SHA-NI fast path is active (introspection for tests/bench).

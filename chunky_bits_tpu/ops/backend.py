@@ -118,6 +118,13 @@ def get_backend(name: Optional[str] = None) -> ErasureBackend:
         except Exception as err:
             raise ErasureError(
                 f"mesh jax backend {name!r} unavailable: {err}") from err
+        # Register the canonical resolved name AND the requested spelling
+        # so repeat lookups under either hit the cache.
+        register_backend(backend)
+        if backend.name != name:
+            with _REGISTRY_LOCK:
+                _REGISTRY[name] = backend
+        return backend
     elif name == "auto":
         try:
             from chunky_bits_tpu.ops.cpu_backend import NativeBackend

@@ -14,6 +14,7 @@ import hashlib
 import os
 import subprocess
 import threading
+from typing import Optional
 
 import numpy as np
 
@@ -86,6 +87,11 @@ def _load():
         lib.cb_sha256.restype = None
         lib.cb_sha256_is_accelerated.argtypes = []
         lib.cb_sha256_is_accelerated.restype = ctypes.c_int
+        lib.cb_sha256_file.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_void_p,
+        ]
+        lib.cb_sha256_file.restype = ctypes.c_int
         lib.cb_sha256_rows.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
             ctypes.c_void_p, ctypes.c_int,
@@ -122,6 +128,27 @@ def sha256_buf(data) -> bytes:
 
 def sha256_is_accelerated() -> bool:
     return bool(_load().cb_sha256_is_accelerated())
+
+
+_ALL = 0xFFFFFFFFFFFFFFFF
+
+
+def sha256_file(path: str, start: int = 0,
+                length: Optional[int] = None) -> bytes:
+    """Hash a file byte range in one native streaming pass (SHA-NI),
+    never surfacing the bytes to Python — the read+verify fusion for
+    local chunk verification.  ``length=None`` hashes start..EOF.
+    Raises OSError on I/O failure or a short file."""
+    lib = _load()
+    out = ctypes.create_string_buffer(32)
+    want = _ALL if length is None else int(length)
+    rc = lib.cb_sha256_file(os.fsencode(path), int(start), want, out)
+    if rc == -2:
+        raise OSError(f"short file: {path!r} has fewer than "
+                      f"{start + (length or 0)} bytes")
+    if rc != 0:
+        raise OSError(f"cannot hash {path!r}")
+    return out.raw
 
 
 def sha256_rows(rows: np.ndarray, out: np.ndarray) -> None:

@@ -57,7 +57,6 @@ class MeshJaxBackend(ErasureBackend):
     def __init__(self, spec: str):
         from chunky_bits_tpu.parallel import mesh as mesh_mod
 
-        self.name = f"jax:{spec}"
         axes = parse_mesh_spec(spec)
         import jax
 
@@ -69,6 +68,7 @@ class MeshJaxBackend(ErasureBackend):
             self.mesh = mesh_mod.make_stripe_mesh(dp * tp, dp=dp, tp=tp)
             self._apply = mesh_mod.wide_apply_sharded
             self.dp, self.minor = dp, tp
+            minor_name = "tp"
         else:
             dp, sp = axes.get("dp"), axes.get("sp")
             n_dev = dp * sp if (dp and sp) else None
@@ -76,6 +76,11 @@ class MeshJaxBackend(ErasureBackend):
             self._apply = mesh_mod.sharded_apply
             self.dp = self.mesh.shape["dp"]
             self.minor = self.mesh.shape["sp"]
+            minor_name = "sp"
+        # Canonical name from the *resolved* axes so spelling variants
+        # ("dp=4, sp=2", "sp2" on 8 devices, ...) dedupe to one registry
+        # entry and one set of jitted executables.
+        self.name = f"jax:dp{self.dp},{minor_name}{self.minor}"
 
     def apply_matrix(self, mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
         b, k, s = shards.shape

@@ -52,6 +52,9 @@ def _make_mesh_2d(n_devices, first, first_name, second, second_name):
 
     devices = jax.devices()
     n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(
+            f"mesh needs {n} devices, only {len(devices)} visible")
     devices = devices[:n]
     if first is None and second is None:
         second = 1

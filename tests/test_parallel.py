@@ -249,3 +249,16 @@ def test_mesh_backend_cluster_lifecycle(tmp_path, eight_devices):
             aio_mod.get_running_loop()) is not None
 
     aio_mod.run(main())
+
+
+def test_mesh_backend_name_normalization(eight_devices):
+    from chunky_bits_tpu.ops.backend import get_backend
+
+    a = get_backend("jax:dp=4, sp=2")
+    b = get_backend("jax:dp4,sp2")
+    assert a is b
+    assert a.name == "jax:dp4,sp2"
+    # too-many-devices specs fail with a clear message
+    from chunky_bits_tpu.errors import ErasureError
+    with pytest.raises(ErasureError, match="devices"):
+        get_backend("jax:dp64,sp2")
