@@ -341,3 +341,96 @@ def test_metadata_put_script(tmp_path):
             await failing.write("g", {"length": 0, "parts": []})
 
     asyncio.run(main())
+
+
+def test_parity_zero_profile(tmp_path):
+    """data-only profile (examples/zones.yaml lowlatency shape: p=0):
+    writes produce no parity chunks, reads and verify work, and chunk
+    loss is unrecoverable by design."""
+    dirs = []
+    for i in range(4):
+        d = tmp_path / f"disk{i}"
+        d.mkdir()
+        dirs.append(str(d))
+    meta = tmp_path / "meta"
+    meta.mkdir()
+    cluster = Cluster.from_obj({
+        "destinations": [{"location": x} for x in dirs],
+        "metadata": {"type": "path", "format": "yaml", "path": str(meta)},
+        "profiles": {"default": {"data": 3, "parity": 0,
+                                 "chunk_size": 12}},
+    })
+    payload = os.urandom(30000)
+
+    async def main():
+        from chunky_bits_tpu.errors import FileReadError
+
+        await cluster.write_file("x", aio.BytesReader(payload),
+                                 cluster.get_profile())
+        ref = await cluster.get_file_ref("x")
+        for part in ref.parts:
+            assert part.parity == []
+            assert len(part.data) == 3
+        got = await ref.read_builder().read_all()
+        assert got == payload
+        report = await ref.verify()
+        assert report.integrity() == FileIntegrity.VALID
+        # without parity, a lost chunk is gone
+        os.remove(ref.parts[0].data[0].locations[0].target)
+        with pytest.raises(FileReadError):
+            await (await cluster.get_file_ref("x")) \
+                .read_builder().read_all()
+
+    asyncio.run(main())
+
+
+def test_resilver_over_http_nodes(tmp_path):
+    """Delete-and-resilver against real (in-process) HTTP storage nodes:
+    repaired shards are re-placed over HTTP PUT, and the node already
+    holding a sibling shard is excluded (destination.rs:85-94)."""
+    from tests.http_node import FakeHttpNode
+
+    async def main():
+        nodes = [await FakeHttpNode().start() for _ in range(5)]
+        meta = tmp_path / "meta"
+        meta.mkdir()
+        try:
+            cluster = Cluster.from_obj({
+                "destinations": [{"location": n.url + "/"} for n in nodes],
+                "metadata": {"type": "path", "format": "yaml",
+                             "path": str(meta)},
+                "profiles": {"default": {"data": 3, "parity": 2,
+                                         "chunk_size": 12}},
+            })
+            payload = os.urandom(40000)
+            await cluster.write_file("x", aio.BytesReader(payload),
+                                     cluster.get_profile())
+            ref = await cluster.get_file_ref("x")
+            # drop one data chunk per part from the node stores
+            for part in ref.parts:
+                victim = str(part.data[0].locations[0])
+                for n in nodes:
+                    key = victim[len(n.url) + 1:] \
+                        if victim.startswith(n.url) else None
+                    if key is not None:
+                        assert n.store.pop(key, None) is not None
+                        break
+                else:
+                    raise AssertionError(f"no node held {victim}")
+            report = await ref.verify()
+            assert report.integrity() == FileIntegrity.DEGRADED
+            resilver_report = await ref.resilver(
+                cluster.get_destination(cluster.get_profile()))
+            assert resilver_report.new_locations()
+            # updated ref must verify Valid and read back identical
+            await cluster.write_file_ref("x", ref)
+            ref2 = await cluster.get_file_ref("x")
+            report = await ref2.verify()
+            assert report.integrity() == FileIntegrity.VALID
+            got = await ref2.read_builder().read_all()
+            assert got == payload
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    asyncio.run(main())
