@@ -1,0 +1,142 @@
+"""Seeded chaos soak: random op sequences against a live cluster.
+
+The reference pins behavior with one scripted delete-and-resilver cycle
+(tests/cluster.rs:145-231).  This drives a longer randomized sequence —
+write, overwrite, read, corrupt, delete (bounded by p per part),
+verify, resilver — asserting the system's core invariants after every
+step:
+
+* with at most p chunks damaged per part, reads stay byte-identical;
+* resilver always returns an object to Valid and its content survives;
+* listing reflects every object ever written.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from chunky_bits_tpu.cluster import Cluster
+from chunky_bits_tpu.file import FileIntegrity
+from chunky_bits_tpu.utils import aio
+
+
+@pytest.mark.parametrize("seed", [1, 7])
+def test_chaos_soak(tmp_path, seed):
+    rng = np.random.default_rng(seed)
+    root = tmp_path / f"s{seed}"
+    dirs = []
+    for i in range(6):
+        d = root / f"disk{i}"
+        d.mkdir(parents=True)
+        dirs.append(str(d))
+    meta = root / "meta"
+    meta.mkdir()
+    cluster = Cluster.from_obj({
+        "destinations": [{"location": x} for x in dirs],
+        "metadata": {"type": "path", "format": "yaml", "path": str(meta)},
+        "profiles": {"default": {"data": 3, "parity": 2,
+                                 "chunk_size": 12}},
+    })
+
+    contents: dict[str, bytes] = {}
+    # chunks we have damaged since the last resilver, per object:
+    # {name: set of (part_idx, chunk_idx)} — never exceeds p per part
+    damaged: dict[str, set] = {}
+
+    def chunk_path(part_obj, ci):
+        chunks = part_obj["data"] + part_obj["parity"]
+        t = chunks[ci]["locations"][0]
+        return t[len("file://"):] if t.startswith("file://") else t
+
+    async def read_meta(name):
+        import yaml
+
+        return yaml.safe_load((meta / name).read_text())
+
+    async def op_write(name):
+        size = int(rng.integers(1, 60000))
+        payload = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        await cluster.write_file(name, aio.BytesReader(payload),
+                                 cluster.get_profile())
+        contents[name] = payload
+        damaged[name] = set()
+
+    async def op_read(name):
+        got = await (await cluster.get_file_ref(name)) \
+            .read_builder().read_all()
+        assert got == contents[name], f"read mismatch for {name}"
+
+    async def op_damage(name, corrupt):
+        obj = await read_meta(name)
+        part_idx = int(rng.integers(0, len(obj["parts"])))
+        part_obj = obj["parts"][part_idx]
+        n_chunks = len(part_obj["data"]) + len(part_obj["parity"])
+        hurt_here = {c for (p_, c) in damaged[name] if p_ == part_idx}
+        if len(hurt_here) >= 2:  # p == 2: stay reconstructible
+            return
+        choices = [c for c in range(n_chunks) if c not in hurt_here]
+        ci = int(rng.choice(choices))
+        path = chunk_path(part_obj, ci)
+        if not os.path.exists(path):
+            return  # shared content-addressed chunk already damaged
+        if corrupt:
+            raw = bytearray(open(path, "rb").read())
+            raw[int(rng.integers(0, len(raw)))] ^= 0x01
+            open(path, "wb").write(bytes(raw))
+        else:
+            os.remove(path)
+        damaged[name].add((part_idx, ci))
+
+    async def op_verify(name):
+        report = await (await cluster.get_file_ref(name)).verify()
+        if damaged[name]:
+            assert report.integrity() != FileIntegrity.VALID, \
+                f"damage to {name} not detected"
+        else:
+            assert report.integrity() == FileIntegrity.VALID
+
+    async def op_resilver(name):
+        ref = await cluster.get_file_ref(name)
+        await ref.resilver(cluster.get_destination(cluster.get_profile()))
+        await cluster.write_file_ref(name, ref)
+        damaged[name] = set()
+        report = await (await cluster.get_file_ref(name)).verify()
+        assert report.integrity() == FileIntegrity.VALID
+        await op_read(name)
+
+    async def main():
+        await op_write("obj0")
+        for step in range(40):
+            names = list(contents)
+            name = names[int(rng.integers(0, len(names)))]
+            op = rng.choice(
+                ["write", "overwrite", "read", "corrupt", "delete",
+                 "verify", "resilver"])
+            if op == "write":
+                await op_write(f"obj{len(contents)}")
+            elif op == "overwrite":
+                await op_write(name)
+            elif op == "read":
+                await op_read(name)
+            elif op == "corrupt":
+                await op_damage(name, corrupt=True)
+                await op_read(name)
+            elif op == "delete":
+                await op_damage(name, corrupt=False)
+                await op_read(name)
+            elif op == "verify":
+                await op_verify(name)
+            elif op == "resilver":
+                await op_resilver(name)
+        # final sweep: repair everything, then everything is Valid
+        for name in contents:
+            await op_resilver(name)
+        listed = await cluster.list_files("")
+        listed_names = {str(x) for x in listed}
+        for name in contents:
+            assert any(name in x for x in listed_names), \
+                f"{name} missing from listing {listed_names}"
+
+    asyncio.run(main())
