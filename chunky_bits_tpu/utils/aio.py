@@ -167,18 +167,28 @@ async def read_exact_or_eof(reader: AsyncByteReader, n: int) -> bytes:
 
 async def copy_reader_to_file(reader: AsyncByteReader, path: str,
                               chunk: int = 1 << 20) -> int:
-    """Streaming copy with thread-offloaded writes; returns bytes copied."""
+    """Streaming copy with thread-offloaded writes, double-buffered: the
+    write of block N overlaps the read of block N+1 (the reference's
+    io_copy overlap, src/bin/chunky-bits/util.rs:14-59, without the
+    unsafe 'static transmutes).  Returns bytes copied."""
     total = 0
     f = await asyncio.to_thread(open, path, "wb")
+    pending: Optional[asyncio.Task] = None
     try:
         while True:
             data = await reader.read(chunk)
+            if pending is not None:
+                await pending
+                pending = None
             if not data:
                 break
-            await asyncio.to_thread(f.write, data)
+            pending = asyncio.ensure_future(
+                asyncio.to_thread(f.write, data))
             total += len(data)
         await asyncio.to_thread(f.flush)
     finally:
+        if pending is not None:
+            await asyncio.gather(pending, return_exceptions=True)
         await asyncio.to_thread(f.close)
     return total
 
