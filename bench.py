@@ -162,8 +162,6 @@ def bench_cpu_reference() -> None:
     """BASELINE.md config 1: the CPU oracle on the reference's default
     geometry (d=3 p=2, 1 MiB chunks) — the number the TPU path is
     compared against.  Single JSON line on stdout."""
-    import time as _time
-
     from chunky_bits_tpu.ops import matrix
     from chunky_bits_tpu.ops.backend import get_backend
 
@@ -175,9 +173,9 @@ def bench_cpu_reference() -> None:
     backend.apply_matrix(enc[d:], data)  # warm (thread pool, tables)
     best = float("inf")
     for _ in range(3):
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         backend.apply_matrix(enc[d:], data)
-        best = min(best, _time.perf_counter() - t0)
+        best = min(best, time.perf_counter() - t0)
     gib = batch * d * size / best / (1 << 30)
     print(json.dumps({
         "metric": "cpu_native_parity_encode_gibps_d3p2_1mib",
@@ -192,7 +190,7 @@ def bench_small_objects() -> None:
     through the shared EncodeHashBatcher.  Reports aggregate ingest-side
     encode+hash throughput and the achieved coalescing factor."""
     import asyncio
-    import time as _time
+    import os
 
     from chunky_bits_tpu.ops.batching import EncodeHashBatcher
 
@@ -213,14 +211,12 @@ def bench_small_objects() -> None:
                 await batcher.encode_hash(d, p, stacked)
 
         await one(objs[0])  # warm
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         await asyncio.gather(*[one(o) for o in objs[1:]])
-        dt = _time.perf_counter() - t0
+        dt = time.perf_counter() - t0
         coalesce = (n_objects - 1) / max(batcher.dispatches - 1, 1)
-        import os as _os
-
         print(f"# coalescing factor: {coalesce:.1f} objects/dispatch; "
-              f"host cores: {_os.cpu_count()} (per-shard SHA-256 is "
+              f"host cores: {os.cpu_count()} (per-shard SHA-256 is "
               f"host-side and scales with cores)", file=sys.stderr)
         return (n_objects - 1) * obj_bytes / dt / (1 << 30)
 

@@ -45,8 +45,7 @@ class Cluster:
         self.profiles = profiles
         self.tunables = tunables or Tunables()
         # per-event-loop shared encode batchers (see _encode_batcher)
-        self._encode_batchers: "weakref.WeakKeyDictionary" = \
-            weakref.WeakKeyDictionary()
+        self._encode_batchers = weakref.WeakKeyDictionary()
 
     # ---- serde ----
 
@@ -117,8 +116,7 @@ class Cluster:
         into this cluster (e.g. parallel gateway PUTs of small objects)
         coalesce into single device dispatches.  Device backends only:
         the native path's fused zero-copy pass beats an extra memcpy."""
-        backend = self.tunables.backend or ""
-        if not backend.startswith("jax"):
+        if not self.tunables.is_device_backend():
             return None
         loop = asyncio.get_running_loop()
         batcher = self._encode_batchers.get(loop)
@@ -133,8 +131,7 @@ class Cluster:
         # A device backend amortizes dispatch overhead by staging several
         # parts into one batched encode (writer.py batch staging) and by
         # coalescing across concurrent writes (shared encode batcher).
-        batch_parts = 8 if (self.tunables.backend or "").startswith(
-            "jax") else 1
+        batch_parts = 8 if self.tunables.is_device_backend() else 1
         return (
             FileWriteBuilder()
             .with_destination(self.get_destination(profile))

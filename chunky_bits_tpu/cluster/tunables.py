@@ -26,6 +26,12 @@ class Tunables:
     user_agent: Optional[str] = None
     backend: Optional[str] = None  # erasure backend name (None = auto)
 
+    def is_device_backend(self) -> bool:
+        """True when the erasure plane runs on an accelerator ("jax" or a
+        mesh spec like "jax:dp4,sp2") — the regime where batching layers
+        amortize dispatch overhead."""
+        return (self.backend or "").startswith("jax")
+
     def __post_init__(self) -> None:
         self._location_context = LocationContext(
             on_conflict=self.on_conflict,
