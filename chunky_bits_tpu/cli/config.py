@@ -11,7 +11,6 @@ overlay (:252-290).
 from __future__ import annotations
 
 import asyncio
-import os
 from typing import Optional
 
 import yaml

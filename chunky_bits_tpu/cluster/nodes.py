@@ -13,7 +13,6 @@ from dataclasses import dataclass, field
 
 from chunky_bits_tpu.errors import SerdeError
 from chunky_bits_tpu.file.weighted_location import (
-    DEFAULT_WEIGHT,
     WeightedLocation,
 )
 

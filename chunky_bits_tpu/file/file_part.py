@@ -17,7 +17,7 @@ import asyncio
 import enum
 import random
 from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from typing import Optional
 
 import numpy as np
 

@@ -19,7 +19,7 @@ contract.
 from __future__ import annotations
 
 import asyncio
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 from chunky_bits_tpu.errors import SerdeError

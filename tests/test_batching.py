@@ -8,7 +8,6 @@ propagation, and the wired-in degraded read / resilver paths.
 """
 
 import asyncio
-import hashlib
 import os
 
 import numpy as np

@@ -20,7 +20,7 @@ from chunky_bits_tpu.errors import (
     NotEnoughWriters,
     SerdeError,
 )
-from chunky_bits_tpu.file import FileIntegrity, FileReadBuilder, new_profiler
+from chunky_bits_tpu.file import FileIntegrity, FileReadBuilder
 from chunky_bits_tpu.utils import aio
 
 # the examples/test.yaml shape with paths rewritten into tempdirs
