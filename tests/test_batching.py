@@ -250,3 +250,31 @@ def test_cluster_concurrent_small_writes_coalesce(tmp_path):
             assert got == data
 
     asyncio.run(main())
+
+
+def test_batcher_caller_cancellation():
+    """Cancelling one coalesced caller must not hang or corrupt the
+    others sharing its dispatch group."""
+    d, p, size = 3, 2, 4096
+    parts = _make_parts(6, d, p, size, seed=3)
+
+    async def main():
+        batcher = ReconstructBatcher(backend="numpy")
+
+        async def one(rows):
+            punched = list(rows)
+            punched[0] = None
+            return await batcher.reconstruct(d, p, punched)
+
+        tasks = [asyncio.ensure_future(one(r)) for r in parts]
+        tasks[2].cancel()
+        results = await asyncio.gather(*tasks, return_exceptions=True)
+        assert isinstance(results[2], asyncio.CancelledError)
+        for got, want in zip(
+                [r for i, r in enumerate(results) if i != 2],
+                [p_ for i, p_ in enumerate(parts) if i != 2]):
+            assert not isinstance(got, BaseException), got
+            for i in range(d + p):
+                assert np.array_equal(got[i], want[i])
+
+    asyncio.run(main())

@@ -234,3 +234,22 @@ def test_broken_pipe_quiet(cluster_yaml, tmp_path):
     proc = run_cli("cat", f"{cluster_yaml}#objects/pipe",
                    pipe_to="head -c 64 >/dev/null")
     assert b"Traceback" not in proc.stderr
+
+
+def test_python_decoder_interop(cluster_yaml, tmp_path):
+    """The reference's read-only Python decoder contract: python/
+    chunky-bits.py must reassemble a file from a file reference written
+    by this framework (data chunks only, sha256-verified)."""
+    payload = os.urandom(300000)
+    src = tmp_path / "in.bin"
+    src.write_bytes(payload)
+    run_cli("cp", str(src), f"{cluster_yaml}#files/interop")
+    ref_path = tmp_path / "metadata" / "files" / "interop"
+    env = dict(os.environ)
+    env.setdefault("PYTHONPATH", REPO)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "python", "chunky-bits.py"),
+         str(ref_path)],
+        capture_output=True, env=env)
+    assert proc.returncode == 0, proc.stderr.decode()
+    assert proc.stdout == payload
