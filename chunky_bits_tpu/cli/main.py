@@ -153,7 +153,18 @@ async def run(args) -> int:
     config = await Config.load_or_default(
         args.config, chunk_size=args.chunk_size,
         data_chunks=args.data_chunks, parity_chunks=args.parity_chunks)
+    try:
+        return await _run_command(args, config)
+    finally:
+        # Close loop-bound aiohttp sessions before the loop shuts down, or
+        # aiohttp warns "Unclosed client session" at interpreter exit.
+        from chunky_bits_tpu.file.location import default_context
 
+        await config.aclose()
+        await default_context().aclose()
+
+
+async def _run_command(args, config) -> int:
     cmd = args.command
     if cmd == "cat":
         destination = ClusterLocation.parse("-")
