@@ -253,3 +253,31 @@ def test_python_decoder_interop(cluster_yaml, tmp_path):
         capture_output=True, env=env)
     assert proc.returncode == 0, proc.stderr.decode()
     assert proc.stdout == payload
+
+
+def test_cp_cluster_to_cluster(cluster_yaml, tmp_path):
+    """cp cluster#a cluster2#b: read pipeline of one cluster feeding the
+    write pipeline of another."""
+    dirs2 = []
+    for i in range(5):
+        d = tmp_path / f"second{i}"
+        d.mkdir()
+        dirs2.append(str(d))
+    meta2 = tmp_path / "metadata2"
+    meta2.mkdir()
+    second = tmp_path / "cluster2.yaml"
+    second.write_text(yaml.safe_dump({
+        "destinations": [{"location": d} for d in dirs2],
+        "metadata": {"type": "path", "format": "yaml", "path": str(meta2)},
+        "profiles": {"default": {"data": 4, "parity": 1,
+                                 "chunk_size": 14}},
+    }))
+    payload = os.urandom(200000)
+    run_cli("cp", "-", f"{cluster_yaml}#src-obj", input=payload)
+    run_cli("cp", f"{cluster_yaml}#src-obj", f"{second}#dst-obj")
+    out = run_cli("cat", f"{second}#dst-obj")
+    assert out.stdout == payload
+    # second cluster re-encoded with its own geometry
+    meta = yaml.safe_load((meta2 / "dst-obj").read_text())
+    assert len(meta["parts"][0]["data"]) == 4
+    assert len(meta["parts"][0]["parity"]) == 1
