@@ -434,3 +434,45 @@ def test_resilver_over_http_nodes(tmp_path):
                 await n.stop()
 
     asyncio.run(main())
+
+
+def test_placement_is_hash_seeded_deterministic(tmp_path):
+    """The placement RNG is seeded from the shard hash (writer.rs:80-85):
+    writing identical content twice into identical fresh clusters lands
+    every shard on the same nodes."""
+    def build(root):
+        dirs = []
+        for i in range(6):
+            d = root / f"disk{i}"
+            d.mkdir(parents=True)
+            dirs.append(str(d))
+        meta = root / "meta"
+        meta.mkdir()
+        return Cluster.from_obj({
+            "destinations": [{"location": x} for x in dirs],
+            "metadata": {"type": "path", "format": "yaml",
+                         "path": str(meta)},
+            "profiles": {"default": {"data": 3, "parity": 2,
+                                     "chunk_size": 12}},
+        }), dirs
+
+    payload = os.urandom(30000)
+
+    async def placements(root):
+        cluster, dirs = build(root)
+        await cluster.write_file("x", aio.BytesReader(payload),
+                                 cluster.get_profile())
+        ref = await cluster.get_file_ref("x")
+        out = []
+        for part in ref.parts:
+            for chunk in part.data + part.parity:
+                # disk index of the first location, relative to its root
+                target = chunk.locations[0].target
+                idx = next(i for i, d in enumerate(dirs)
+                           if target.startswith(d))
+                out.append(idx)
+        return out
+
+    a = asyncio.run(placements(tmp_path / "a"))
+    b = asyncio.run(placements(tmp_path / "b"))
+    assert a == b
