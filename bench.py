@@ -184,6 +184,55 @@ def bench_cpu_reference() -> None:
     }))
 
 
+def bench_batched_repair() -> None:
+    """BASELINE.md config 3's host-path shape: many degraded parts
+    sharing one erasure pattern (the common node-loss case) rebuilt
+    through the ReconstructBatcher's coalesced dispatches — the repair
+    analogue of config 4.  Single JSON line on stdout."""
+    import asyncio
+
+    from chunky_bits_tpu.ops.backend import ErasureCoder, get_backend
+    from chunky_bits_tpu.ops.batching import ReconstructBatcher
+
+    d, p, size = 10, 4, 1 << 20
+    n_parts = 40
+    rng = np.random.default_rng(0)
+    coder = ErasureCoder(d, p, get_backend())
+    parts = []
+    for _ in range(n_parts):
+        data = rng.integers(0, 256, (1, d, size), dtype=np.uint8)
+        parity = coder.encode_batch(data)
+        rows = [data[0, i] for i in range(d)] + [parity[0, i]
+                                                 for i in range(p)]
+        for i in (0, 3, 11, 13):  # the same 4 erasures on every part
+            rows[i] = None
+        parts.append(rows)
+
+    async def run() -> float:
+        batcher = ReconstructBatcher()
+        sem = asyncio.Semaphore(10)  # resilver's in-flight bound
+
+        async def one(rows):
+            async with sem:
+                return await batcher.reconstruct(d, p, list(rows))
+
+        await one(parts[0])  # warm
+        t0 = time.perf_counter()
+        await asyncio.gather(*[one(r) for r in parts[1:]])
+        dt = time.perf_counter() - t0
+        coalesce = (n_parts - 1) / max(batcher.dispatches - 1, 1)
+        print(f"# coalescing factor: {coalesce:.1f} parts/dispatch",
+              file=sys.stderr)
+        return (n_parts - 1) * d * size / dt / (1 << 30)
+
+    gib = asyncio.run(run())
+    print(json.dumps({
+        "metric": "batched_repair_reconstruct_gibps_d10p4_4erasures",
+        "value": round(gib, 2), "unit": "GiB/s",
+        "vs_baseline": round(gib / 5.0, 2),
+    }))
+
+
 def bench_small_objects() -> None:
     """BASELINE.md config 4's compute core: many concurrent small-object
     encodes (d=8 p=3, 4 MiB objects => [1, 8, S] batches) coalescing
@@ -232,12 +281,14 @@ if __name__ == "__main__":
     # Default (no args): BASELINE config 2/3 on the device — the driver's
     # recorded metric.  --config 1|4 run the auxiliary BASELINE.md configs.
     if "--config" in sys.argv:
-        configs = {"1": bench_cpu_reference, "4": bench_small_objects}
+        configs = {"1": bench_cpu_reference, "3": bench_batched_repair,
+                   "4": bench_small_objects}
         idx = sys.argv.index("--config") + 1
         which = sys.argv[idx] if idx < len(sys.argv) else ""
         if which not in configs:
-            print(f"usage: bench.py [--config {{1,4}}] — configs 2/3 are "
-                  f"the default no-arg run (got {which!r})", file=sys.stderr)
+            print(f"usage: bench.py [--config {{1,3,4}}] — config 2 (and "
+                  f"the decode kernel of 3) is the default no-arg run "
+                  f"(got {which!r})", file=sys.stderr)
             sys.exit(2)
         configs[which]()
     else:
