@@ -140,3 +140,96 @@ def test_chaos_soak(tmp_path, seed):
                 f"{name} missing from listing {listed_names}"
 
     asyncio.run(main())
+
+
+def test_chaos_soak_http_nodes(tmp_path):
+    """The same invariants over in-process HTTP storage nodes: damage is
+    dropped/corrupted in the node stores, repair re-places over HTTP."""
+    from tests.http_node import FakeHttpNode
+
+    rng = np.random.default_rng(3)
+    meta = tmp_path / "meta"
+    meta.mkdir()
+
+    async def main():
+        nodes = [await FakeHttpNode().start() for _ in range(6)]
+        try:
+            cluster = Cluster.from_obj({
+                "destinations": [{"location": n.url + "/"} for n in nodes],
+                "metadata": {"type": "path", "format": "yaml",
+                             "path": str(meta)},
+                "profiles": {"default": {"data": 3, "parity": 2,
+                                         "chunk_size": 12}},
+            })
+            contents: dict[str, bytes] = {}
+            damaged: dict[str, set] = {}
+
+            def find_node(url: str):
+                for n in nodes:
+                    if url.startswith(n.url):
+                        return n, url[len(n.url) + 1:]
+                raise AssertionError(url)
+
+            async def write(name):
+                size = int(rng.integers(1, 40000))
+                payload = rng.integers(0, 256, size,
+                                       dtype=np.uint8).tobytes()
+                await cluster.write_file(name, aio.BytesReader(payload),
+                                         cluster.get_profile())
+                contents[name] = payload
+                damaged[name] = set()
+
+            async def damage(name):
+                ref = await cluster.get_file_ref(name)
+                pi = int(rng.integers(0, len(ref.parts)))
+                part = ref.parts[pi]
+                chunks = part.data + part.parity
+                hurt = {c for (p_, c) in damaged[name] if p_ == pi}
+                if len(hurt) >= 2:
+                    return
+                ci = int(rng.choice(
+                    [c for c in range(len(chunks)) if c not in hurt]))
+                node, key = find_node(str(chunks[ci].locations[0]))
+                if key not in node.store:
+                    return
+                if rng.random() < 0.5:
+                    raw = bytearray(node.store[key])
+                    raw[int(rng.integers(0, len(raw)))] ^= 1
+                    node.store[key] = bytes(raw)
+                else:
+                    del node.store[key]
+                damaged[name].add((pi, ci))
+
+            async def repair(name):
+                ref = await cluster.get_file_ref(name)
+                await ref.resilver(
+                    cluster.get_destination(cluster.get_profile()))
+                await cluster.write_file_ref(name, ref)
+                damaged[name] = set()
+                report = await (await cluster.get_file_ref(name)).verify()
+                assert report.integrity() == FileIntegrity.VALID
+
+            await write("obj0")
+            for _ in range(25):
+                name = list(contents)[int(rng.integers(0, len(contents)))]
+                op = rng.choice(["write", "read", "damage", "repair"])
+                if op == "write":
+                    await write(f"obj{len(contents)}")
+                elif op == "read":
+                    got = await (await cluster.get_file_ref(name)) \
+                        .read_builder().read_all()
+                    assert got == contents[name]
+                elif op == "damage":
+                    await damage(name)
+                    got = await (await cluster.get_file_ref(name)) \
+                        .read_builder().read_all()
+                    assert got == contents[name]
+                else:
+                    await repair(name)
+            for name in contents:
+                await repair(name)
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    asyncio.run(main())
