@@ -204,3 +204,32 @@ def test_verify_fused_file_hash(tmp_path, monkeypatch):
         assert report.integrity().name == "DEGRADED"
 
     asyncio.run(main())
+
+
+def test_sha256_file_ranges(tmp_path):
+    """Native file hasher KATs vs hashlib: full file, interior range,
+    tail, empty range, short-file and missing-file errors — the range
+    support that lets fused verify cover migrated (range-sliced) refs."""
+    import hashlib
+
+    from chunky_bits_tpu.ops.cpu_backend import sha256_file
+
+    data = np.random.default_rng(31).integers(
+        0, 256, 3 * (1 << 20) + 137, dtype=np.uint8).tobytes()
+    path = tmp_path / "blob.bin"
+    path.write_bytes(data)
+    p = str(path)
+
+    assert sha256_file(p) == hashlib.sha256(data).digest()
+    assert sha256_file(p, 100, 5000) == \
+        hashlib.sha256(data[100:5100]).digest()
+    assert sha256_file(p, len(data) - 10) == \
+        hashlib.sha256(data[-10:]).digest()
+    assert sha256_file(p, 0, 0) == hashlib.sha256(b"").digest()
+    # exact 64-byte-boundary lengths stress the finalize padding
+    for n in (55, 56, 63, 64, 65, 119, 128):
+        assert sha256_file(p, 0, n) == hashlib.sha256(data[:n]).digest()
+    with pytest.raises(OSError):
+        sha256_file(p, 0, len(data) + 1)  # short file
+    with pytest.raises(OSError):
+        sha256_file(str(tmp_path / "missing.bin"))
