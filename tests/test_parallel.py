@@ -153,10 +153,13 @@ def test_pallas_kernel_interpret_identity():
     d, p = 10, 4
     enc = matrix.build_encode_matrix(d, p)
     rng = np.random.default_rng(2)
-    data = rng.integers(0, 256, (2, d, 256), dtype=np.uint8)
-    got = np.asarray(apply_matrix_pallas(enc[d:], data, interpret=True))
-    want = ErasureCoder(d, p, NumpyBackend()).encode_batch(data)
-    assert np.array_equal(got, want)
+    oracle = ErasureCoder(d, p, NumpyBackend())
+    for batch in (2, 3):  # even -> two parts per grid cell, odd -> one
+        data = rng.integers(0, 256, (batch, d, 256), dtype=np.uint8)
+        got = np.asarray(apply_matrix_pallas(enc[d:], data,
+                                             interpret=True))
+        want = oracle.encode_batch(data)
+        assert np.array_equal(got, want), batch
 
 
 def test_mesh_backend_spec_parsing():
