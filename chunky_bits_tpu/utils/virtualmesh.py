@@ -1,0 +1,38 @@
+"""Virtual-mesh environment provisioning.
+
+Single source of truth for the recipe that lets multi-device sharding code
+run on hosts with fewer (or zero) real TPU chips: drop the axon tunnel
+pinning, force the CPU platform, and ask XLA for an ``n``-device virtual
+host mesh.  Used by ``tests/conftest.py`` (pytest) and
+``__graft_entry__.dryrun_multichip`` (the driver's multichip check), which
+must never drift apart.
+
+Must stay importable without jax, and the target mapping must be populated
+before the first jax import in the affected process.
+"""
+
+import re
+
+__all__ = ["provision_virtual_mesh"]
+
+
+def provision_virtual_mesh(environ, n_devices: int) -> None:
+    """Mutate ``environ`` (any mutable mapping, e.g. ``os.environ`` or a
+    ``dict`` copy destined for a subprocess) to provision an
+    ``n_devices``-wide virtual CPU mesh.
+
+    Any pre-existing ``--xla_force_host_platform_device_count`` flag is
+    replaced, not kept, so a stale smaller count cannot starve the mesh.
+    """
+    # The axon sitecustomize registers the tunneled-TPU PJRT plugin and
+    # pins JAX_PLATFORMS=axon whenever this is set.
+    environ.pop("PALLAS_AXON_POOL_IPS", None)
+    environ["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\S+",
+        "",
+        environ.get("XLA_FLAGS", ""),
+    )
+    environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()

@@ -6,17 +6,16 @@ imported anywhere in the test process.
 """
 
 import os
+import sys
 
-# The axon sitecustomize registers the tunneled-TPU PJRT plugin whenever
-# PALLAS_AXON_POOL_IPS is set and pins JAX_PLATFORMS=axon; drop both so the
-# suite runs on the virtual 8-device CPU backend.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Shared recipe (also used by __graft_entry__.dryrun_multichip): drop the
+# axon tunnel pinning and run on a virtual 8-device CPU backend.  The
+# helper module is jax-free, so importing it here is safe.
+from chunky_bits_tpu.utils.virtualmesh import provision_virtual_mesh  # noqa: E402
+
+provision_virtual_mesh(os.environ, 8)
 
 # The axon sitecustomize imports jax at interpreter startup (before this
 # file runs), so the env vars above are read too late; force the settings
@@ -29,7 +28,3 @@ except ImportError:
     pass
 else:
     jax.config.update("jax_platforms", "cpu")
-
-import sys  # noqa: E402
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
