@@ -367,9 +367,14 @@ def main(argv=None) -> int:
     except BrokenPipeError:
         # Downstream closed (e.g. `cat ... | head`): die quietly like a
         # coreutils tool.  Point stdout at devnull so the interpreter's
-        # exit-time flush doesn't raise a second time.
-        devnull = os.open(os.devnull, os.O_WRONLY)
-        os.dup2(devnull, sys.stdout.fileno())
+        # exit-time flush doesn't raise a second time.  stdout may not be
+        # backed by a real fd (captured/replaced in embedding harnesses);
+        # still exit quietly then.
+        try:
+            devnull = os.open(os.devnull, os.O_WRONLY)
+            os.dup2(devnull, sys.stdout.fileno())
+        except Exception:
+            pass
         return 141  # 128 + SIGPIPE
 
 

@@ -149,6 +149,12 @@ class MetadataPath:
                 self.put_script, cwd=self.path)
             code = await proc.wait()
             if self.fail_on_script_error and code != 0:
+                # Distinguish signal-death from a nonzero exit like the
+                # reference's ExitCode/Signal variants (error.rs:236-253);
+                # a negative returncode is -signum.
+                if code < 0:
+                    raise MetadataReadError(
+                        f"put_script killed by signal {-code}")
                 raise MetadataReadError(
                     f"put_script exited with code {code}")
 

@@ -32,6 +32,7 @@ from chunky_bits_tpu.file.hashing import AnyHash, Sha256Hash, hash_buf_async
 from chunky_bits_tpu.file.location import Location, LocationContext, \
     default_context
 from chunky_bits_tpu.ops import ErasureCoder, get_coder
+from chunky_bits_tpu.utils import aio
 
 
 class LocationIntegrity(enum.IntEnum):
@@ -295,14 +296,9 @@ class FilePart:
         payloads = list(shards) + list(parity)
         pre_digests = digests if digests is not None \
             else [None] * len(payloads)
-        tasks = [asyncio.ensure_future(hash_and_write(pl, w, dg))
-                 for pl, w, dg in zip(payloads, writers, pre_digests)]
-        try:
-            chunks = await asyncio.gather(*tasks)
-        except BaseException:
-            for t in tasks:
-                t.cancel()
-            raise
+        chunks = await aio.gather_or_cancel(
+            [asyncio.ensure_future(hash_and_write(pl, w, dg))
+             for pl, w, dg in zip(payloads, writers, pre_digests)])
         return FilePart(
             chunksize=buf_length,
             data=list(chunks[:d]),
@@ -311,27 +307,35 @@ class FilePart:
 
     # ---- verify (src/file/file_part.rs:228-251) ----
 
+    #: concurrent location reads per part during verify; with the
+    #: file-level bound (RESILVER_CONCURRENCY parts in flight) this caps
+    #: total open reads at 10×10 where the reference is unbounded
+    #: (every location of every chunk at once, file_part.rs:228-251)
+    VERIFY_READ_CONCURRENCY = 10
+
     async def verify(self, cx: Optional[LocationContext] = None
                      ) -> "VerifyPartReport":
         cx = cx or default_context()
+        sem = asyncio.Semaphore(self.VERIFY_READ_CONCURRENCY)
 
         async def check(ci: int, chunk: Chunk, li: int, location: Location):
-            digest = await _hash_local_fused(chunk, location, cx)
-            if digest is not None:
-                return (ci, li, digest == chunk.hash.value.digest, None)
-            try:
-                data = await location.read(cx)
-            except LocationError as err:
-                return (ci, li, None, str(err))
-            ok = await chunk.hash.verify_async(data)
-            return (ci, li, ok, None)
+            async with sem:
+                digest = await _hash_local_fused(chunk, location, cx)
+                if digest is not None:
+                    return (ci, li, digest == chunk.hash.value.digest, None)
+                try:
+                    data = await location.read(cx)
+                except LocationError as err:
+                    return (ci, li, None, str(err))
+                ok = await chunk.hash.verify_async(data)
+                return (ci, li, ok, None)
 
         jobs = [
-            check(ci, chunk, li, location)
+            asyncio.ensure_future(check(ci, chunk, li, location))
             for ci, chunk in enumerate(self.all_chunks())
             for li, location in enumerate(chunk.locations)
         ]
-        results = await asyncio.gather(*jobs)
+        results = await aio.gather_or_cancel(jobs)
         read_results = {(ci, li): (ok, err) for ci, li, ok, err in results}
         return VerifyPartReport(self, read_results)
 

@@ -30,6 +30,7 @@ from chunky_bits_tpu.file.file_part import (
     VerifyPartReport,
 )
 from chunky_bits_tpu.file.location import Location, LocationContext
+from chunky_bits_tpu.utils import aio
 
 RESILVER_CONCURRENCY = 10  # parts in flight (file_reference.rs:110)
 
@@ -90,9 +91,17 @@ class FileReference:
 
     async def verify(self, cx: Optional[LocationContext] = None
                      ) -> "VerifyFileReport":
-        reports = await asyncio.gather(
-            *[part.verify(cx) for part in self.parts]
-        )
+        # Bounded parts-in-flight, like resilver.  The reference gathers
+        # every part at once (file_reference.rs:78-87) — unbounded sockets
+        # on a 10 GiB file; bounding is a deliberate improvement.
+        sem = asyncio.Semaphore(RESILVER_CONCURRENCY)
+
+        async def one(part: FilePart) -> "VerifyPartReport":
+            async with sem:
+                return await part.verify(cx)
+
+        reports = await aio.gather_or_cancel(
+            [asyncio.ensure_future(one(p)) for p in self.parts])
         return VerifyFileReport(list(reports))
 
     async def resilver(self, destination,
@@ -111,7 +120,13 @@ class FileReference:
                 return await part.resilver(destination, cx, backend=backend,
                                            batcher=batcher)
 
-        reports = await asyncio.gather(*[one(p) for p in self.parts])
+        try:
+            # on failure siblings are cancelled before the drain below, so
+            # no part can submit fresh batcher work after aclose
+            reports = await aio.gather_or_cancel(
+                [asyncio.ensure_future(one(p)) for p in self.parts])
+        finally:
+            await batcher.aclose()
         return ResilverFileReport(list(reports))
 
 

@@ -134,6 +134,21 @@ def default_context() -> LocationContext:
     return _DEFAULT_CONTEXT
 
 
+class _CountingReader:
+    """Pass-through reader that counts bytes consumed, so streaming
+    writes can profile partial progress on failure.  Ownership of the
+    base reader stays with the caller (no close)."""
+
+    def __init__(self, base):
+        self._base = base
+        self.total = 0
+
+    async def read(self, n: int = -1) -> bytes:
+        data = await self._base.read(n)
+        self.total += len(data)
+        return data
+
+
 class _HttpBodyReader:
     """Wraps an aiohttp response body as an AsyncByteReader, closing the
     response at EOF (or on close(), for early-stopping consumers)."""
@@ -286,6 +301,47 @@ class Location:
     def is_parent_of(self, other: "Location") -> bool:
         return other.is_child_of(self)
 
+    def _check_scheme(self, cx: LocationContext) -> None:
+        """Enforce the ``https_only`` tunable: plain-http targets are
+        refused on every network verb, matching the reference's client
+        built with https-only (src/cluster/tunables.rs:25-32)."""
+        if cx.https_only and self.target.startswith("http://"):
+            raise LocationError(
+                f"https_only is set: refusing plain-http location "
+                f"{self.target}"
+            )
+
+    def _redirect_kwargs(self, cx: LocationContext) -> dict:
+        """Request kwargs for the mutating/HEAD verbs: under https_only,
+        redirects are not followed (a replayed PUT body could otherwise
+        travel a plain-http hop before any post-hoc check)."""
+        return {"allow_redirects": False} if cx.https_only else {}
+
+    def _check_redirect(self, cx: LocationContext, resp) -> None:
+        """Refuse 3xx answers under https_only (paired with
+        ``_redirect_kwargs``); without the tunable aiohttp has already
+        followed them."""
+        if cx.https_only and 300 <= resp.status < 400:
+            resp.release()
+            raise LocationError(
+                f"https_only is set: refusing redirect "
+                f"({resp.status}) from {self.target}"
+            )
+
+    def _check_response_hops(self, cx: LocationContext, resp) -> None:
+        """For GET (where the body is not consumed until after this
+        check): refuse if any redirect hop or the final URL travelled
+        plain http."""
+        if not cx.https_only:
+            return
+        for r in (*resp.history, resp):
+            if r.url.scheme == "http":
+                resp.release()
+                raise LocationError(
+                    f"https_only is set: response for {self.target} "
+                    f"travelled plain http via {r.url}"
+                )
+
     # ---- read path ----
 
     async def reader(self, cx: Optional[LocationContext] = None
@@ -322,6 +378,7 @@ class Location:
                 return aio.ZeroExtendReader(base, rng.length)
             return aio.TakeReader(base, rng.length)
         # HTTP
+        self._check_scheme(cx)
         headers = {}
         if rng.is_specified():
             if rng.length is not None:
@@ -331,9 +388,15 @@ class Location:
                 headers["Range"] = f"bytes={rng.start}-"
         sess = cx.http_session()
         try:
-            resp = await sess.get(self.target, headers=headers)
+            resp = await sess.get(self.target, headers=headers,
+                                  **self._redirect_kwargs(cx))
         except Exception as err:
             raise LocationError(f"http get failed: {err}") from err
+        # Under https_only the request ran with redirects disabled, so a
+        # 3xx is refused before any follow-up leaves the machine; the hop
+        # check is belt-and-braces.
+        self._check_redirect(cx, resp)
+        self._check_response_hops(cx, resp)
         if resp.status >= 400:
             resp.release()
             raise HttpStatusError(resp.status, self.target)
@@ -399,12 +462,15 @@ class Location:
                 except OSError as err:
                     raise LocationError(str(err)) from err
             else:
+                self._check_scheme(cx)
                 sess = cx.http_session()
                 try:
-                    resp = await sess.put(self.target, data=data)
+                    resp = await sess.put(self.target, data=data,
+                                          **self._redirect_kwargs(cx))
                     resp.release()
                 except Exception as err:
                     raise LocationError(f"http put failed: {err}") from err
+                self._check_redirect(cx, resp)
                 if resp.status >= 400:
                     raise HttpStatusError(resp.status, self.target)
         except LocationError as err:
@@ -420,16 +486,19 @@ class Location:
         file (src/file/location.rs:246-309).  Returns bytes written.
         Profiler-hooked (the reference's TODO at location.rs:255)."""
         cx = cx or default_context()
+        if cx.profiler is None:
+            return await self._write_from_reader_impl(reader, cx)
         start = time.monotonic()
-        total = 0
+        # Count consumed bytes on the reader side so a stream that fails
+        # mid-body still profiles its partial progress.
+        counted = _CountingReader(reader)
         try:
-            total = await self._write_from_reader_impl(reader, cx)
+            total = await self._write_from_reader_impl(counted, cx)
         except LocationError as err:
-            if cx.profiler is not None:
-                cx.profiler.log_write(False, str(err), self, total, start)
+            cx.profiler.log_write(False, str(err), self,
+                                  counted.total, start)
             raise
-        if cx.profiler is not None:
-            cx.profiler.log_write(True, None, self, total, start)
+        cx.profiler.log_write(True, None, self, total, start)
         return total
 
     async def _write_from_reader_impl(self, reader: aio.AsyncByteReader,
@@ -443,6 +512,7 @@ class Location:
                 return await aio.copy_reader_to_file(reader, self.target)
             except OSError as err:
                 raise LocationError(str(err)) from err
+        self._check_scheme(cx)
         total = 0
 
         async def gen():
@@ -456,10 +526,12 @@ class Location:
 
         sess = cx.http_session()
         try:
-            resp = await sess.put(self.target, data=gen())
+            resp = await sess.put(self.target, data=gen(),
+                                  **self._redirect_kwargs(cx))
             resp.release()
         except Exception as err:
             raise LocationError(f"http streaming put failed: {err}") from err
+        self._check_redirect(cx, resp)
         if resp.status >= 400:
             raise HttpStatusError(resp.status, self.target)
         return total
@@ -486,12 +558,15 @@ class Location:
             except OSError as err:
                 raise LocationError(str(err)) from err
         else:
+            self._check_scheme(cx)
             sess = cx.http_session()
             try:
-                resp = await sess.delete(self.target)
+                resp = await sess.delete(self.target,
+                                         **self._redirect_kwargs(cx))
                 resp.release()
             except Exception as err:
                 raise LocationError(f"http delete failed: {err}") from err
+            self._check_redirect(cx, resp)
             if resp.status >= 400:
                 raise HttpStatusError(resp.status, self.target)
 
@@ -499,12 +574,15 @@ class Location:
         cx = cx or default_context()
         if self.is_local():
             return await asyncio.to_thread(os.path.exists, self.target)
+        self._check_scheme(cx)
         sess = cx.http_session()
         try:
-            resp = await sess.head(self.target)
+            resp = await sess.head(self.target,
+                                   **self._redirect_kwargs(cx))
             resp.release()
         except Exception as err:
             raise LocationError(f"http head failed: {err}") from err
+        self._check_redirect(cx, resp)
         return resp.status < 400
 
     async def file_len(self, cx: Optional[LocationContext] = None) -> int:
@@ -515,12 +593,15 @@ class Location:
             except OSError as err:
                 raise LocationError(str(err)) from err
             return st.st_size
+        self._check_scheme(cx)
         sess = cx.http_session()
         try:
-            resp = await sess.head(self.target)
+            resp = await sess.head(self.target,
+                                   **self._redirect_kwargs(cx))
             resp.release()
         except Exception as err:
             raise LocationError(f"http head failed: {err}") from err
+        self._check_redirect(cx, resp)
         if resp.status >= 400:
             raise HttpStatusError(resp.status, self.target)
         length = resp.headers.get("Content-Length")

@@ -108,6 +108,7 @@ class FileReadBuilder:
                 t.cancel()
             if tasks:
                 await asyncio.gather(*tasks, return_exceptions=True)
+            await batcher.aclose()
 
     async def _read_part(self, part: FilePart, skip: int,
                          batcher=None) -> bytes:

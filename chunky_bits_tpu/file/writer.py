@@ -151,7 +151,8 @@ class FileWriteBuilder:
                         [row.tobytes() for row in digest_batch[bi]],
                     )
 
-            await asyncio.gather(*(encode_group(*g) for g in groups))
+            await aio.gather_or_cancel(
+                [asyncio.ensure_future(encode_group(*g)) for g in groups])
             return [results[i] for i in range(len(items))]
 
         async def write_part(precomputed) -> FilePart:
@@ -171,14 +172,8 @@ class FileWriteBuilder:
                 for _ in items:
                     sem.release()
                 raise
-            tasks = [asyncio.ensure_future(write_part(x)) for x in pre]
-            try:
-                return await asyncio.gather(*tasks)
-            except BaseException:
-                for t in tasks:
-                    t.cancel()
-                await asyncio.gather(*tasks, return_exceptions=True)
-                raise
+            return await aio.gather_or_cancel(
+                [asyncio.ensure_future(write_part(x)) for x in pre])
 
         def flush() -> None:
             """Hand the staged parts to a background encode+write task —

@@ -84,6 +84,19 @@ class _CoalescingBatcher:
                 if not fut.done():
                     fut.set_result(res)
 
+    async def aclose(self) -> None:
+        """Drain: await the pending collection task and every in-flight
+        dispatch, so owners tearing down (end of a read stream, resilver
+        run, or event loop) never abandon waiter futures mid-flight.
+        Dispatch errors are delivered to their waiters, not raised here."""
+        while True:
+            tasks = set(self._inflight)
+            if self._task is not None and not self._task.done():
+                tasks.add(self._task)
+            if not tasks:
+                return
+            await asyncio.gather(*tasks, return_exceptions=True)
+
     def _run_group(self, key: tuple, payloads: list) -> list:
         raise NotImplementedError
 

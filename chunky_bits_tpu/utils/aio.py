@@ -70,6 +70,20 @@ async def close_reader(reader) -> None:
             await result
 
 
+async def gather_or_cancel(tasks):
+    """``asyncio.gather`` with fail-fast cleanup: on the first error (or
+    outer cancellation) cancel the sibling tasks and await them, so no
+    task keeps running in the background with its exception never
+    retrieved.  Returns the results in order."""
+    try:
+        return await asyncio.gather(*tasks)
+    except BaseException:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        raise
+
+
 class TakeReader:
     """Limit an underlying reader to ``length`` bytes (tokio's ``take``).
     Closes the inner reader once the limit is reached, since the consumer

@@ -25,6 +25,8 @@ class FakeHttpNode:
 
     async def _get(self, request: web.Request) -> web.Response:
         key = request.match_info["key"]
+        if key.startswith("redir/"):
+            raise web.HTTPFound(location=f"/{key[len('redir/'):]}")
         data = self.store.get(key)
         if data is None:
             return web.Response(status=404)

@@ -476,3 +476,24 @@ def test_placement_is_hash_seeded_deterministic(tmp_path):
     a = asyncio.run(placements(tmp_path / "a"))
     b = asyncio.run(placements(tmp_path / "b"))
     assert a == b
+
+
+def test_metadata_put_script_signal(tmp_path):
+    """Signal-death is reported distinctly from a nonzero exit code
+    (the reference's ExitCode/Signal variants, src/error.rs:236-253)."""
+    meta_dir = tmp_path / "meta"
+    meta_dir.mkdir()
+
+    async def main():
+        from chunky_bits_tpu.cluster import MetadataPath
+
+        killed = MetadataPath(str(meta_dir), put_script="kill -TERM $$",
+                              fail_on_script_error=True)
+        with pytest.raises(MetadataReadError, match="signal 15"):
+            await killed.write("sig", {"length": 0, "parts": []})
+        coded = MetadataPath(str(meta_dir), put_script="exit 3",
+                             fail_on_script_error=True)
+        with pytest.raises(MetadataReadError, match="code 3"):
+            await coded.write("code", {"length": 0, "parts": []})
+
+    asyncio.run(main())

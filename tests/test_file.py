@@ -186,3 +186,69 @@ def test_write_empty_file():
         assert ref.parts == []
 
     asyncio.run(main())
+
+
+def test_verify_fanout_is_bounded(tmp_path, monkeypatch):
+    """verify keeps at most 10 parts in flight (like resilver) and at most
+    VERIFY_READ_CONCURRENCY location reads per part — the reference opens
+    every location of every chunk of every part at once
+    (file_reference.rs:78-87, file_part.rs:228-251)."""
+    from chunky_bits_tpu.file.file_part import FilePart
+
+    payload = synthetic_bytes(40 * 3 * 1024, seed=11)  # 40 parts at S=1 KiB
+    dirs = []
+    for i in range(5):
+        d = tmp_path / f"disk{i}"
+        d.mkdir()
+        dirs.append(Location.parse(str(d)))
+
+    async def main():
+        builder = (FileWriteBuilder()
+                   .with_destination(LocationsDestination(dirs))
+                   .with_chunk_size(1024)
+                   .with_data_chunks(3)
+                   .with_parity_chunks(2))
+        ref = await builder.write(aio.BytesReader(payload))
+        assert len(ref.parts) == 40
+
+        in_flight = {"parts": 0, "reads": 0}
+        peaks = {"parts": 0, "reads": 0}
+
+        real_verify = FilePart.verify
+        real_read = Location.read
+
+        async def counting_verify(self, cx=None):
+            in_flight["parts"] += 1
+            peaks["parts"] = max(peaks["parts"], in_flight["parts"])
+            try:
+                return await real_verify(self, cx)
+            finally:
+                in_flight["parts"] -= 1
+
+        async def counting_read(self, cx=None):
+            in_flight["reads"] += 1
+            peaks["reads"] = max(peaks["reads"], in_flight["reads"])
+            try:
+                # yield so overlapping reads actually overlap in counters
+                await asyncio.sleep(0)
+                return await real_read(self, cx)
+            finally:
+                in_flight["reads"] -= 1
+
+        monkeypatch.setattr(FilePart, "verify", counting_verify)
+        monkeypatch.setattr(Location, "read", counting_read)
+        # force the generic read path: the fused local-hash shortcut
+        # would bypass Location.read and leave the read cap untested
+        import chunky_bits_tpu.file.file_part as fp_mod
+
+        async def no_fused(chunk, location, cx):
+            return None
+
+        monkeypatch.setattr(fp_mod, "_hash_local_fused", no_fused)
+        report = await ref.verify()
+        assert report.is_ideal()
+        assert peaks["parts"] <= 10
+        assert peaks["reads"] > 0
+        assert peaks["reads"] <= 10 * FilePart.VERIFY_READ_CONCURRENCY
+
+    asyncio.run(main())

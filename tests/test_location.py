@@ -293,3 +293,108 @@ def test_streaming_profiler_hooks(tmp_path):
         assert writes[0].ok and writes[0].length == len(payload)
 
     asyncio.run(main())
+
+
+def test_https_only_refuses_plain_http():
+    """With the https_only tunable set, every network verb refuses a
+    plain-http location (the reference builds its whole client https-only,
+    src/cluster/tunables.rs:25-32)."""
+    async def main():
+        node = await FakeHttpNode().start()
+        open_cx = LocationContext()
+        cx = LocationContext(https_only=True)
+        cx._sessions = open_cx._sessions
+        try:
+            loc = Location.parse(node.url + "/sec")
+            await loc.write(b"payload", open_cx)  # plain context still works
+            for op in (
+                loc.read(cx),
+                loc.reader(cx),
+                loc.write(b"x", cx),
+                loc.write_from_reader(aio.BytesReader(b"x"), cx),
+                loc.delete(cx),
+                loc.file_exists(cx),
+                loc.file_len(cx),
+            ):
+                with pytest.raises(LocationError, match="https_only"):
+                    await op
+            # nothing was modified through the refusing context
+            assert await loc.read(open_cx) == b"payload"
+            # local locations are unaffected by https_only
+        finally:
+            await open_cx.aclose()
+            await node.stop()
+
+    asyncio.run(main())
+
+
+def test_https_only_leaves_local_alone(tmp_path):
+    f = tmp_path / "f"
+    f.write_bytes(b"local")
+
+    async def main():
+        cx = LocationContext(https_only=True)
+        assert await Location.parse(str(f)).read(cx) == b"local"
+
+    asyncio.run(main())
+
+
+def test_https_only_refuses_redirect_hops():
+    """Under https_only a redirect answer is refused (mutating verbs run
+    with redirects disabled), and a GET whose hop chain touched plain
+    http is refused before the body is consumed.  Stub responses stand in
+    for a TLS endpoint, which the test node cannot provide."""
+    from types import SimpleNamespace
+    from urllib.parse import urlsplit
+
+    cx = LocationContext(https_only=True)
+    loc = Location.http("https://node.example/chunk")
+
+    class StubUrl:
+        def __init__(self, url):
+            self.scheme = urlsplit(url).scheme
+            self._url = url
+
+        def __str__(self):
+            return self._url
+
+    def resp(status, url, history=()):
+        return SimpleNamespace(
+            status=status,
+            url=StubUrl(url),
+            history=tuple(
+                SimpleNamespace(url=StubUrl(u)) for u in history),
+            release=lambda: None,
+        )
+
+    with pytest.raises(LocationError, match="refusing redirect"):
+        loc._check_redirect(cx, resp(302, "https://node.example/chunk"))
+    with pytest.raises(LocationError, match="plain http"):
+        loc._check_response_hops(
+            cx, resp(200, "http://node.example/chunk",
+                     history=["https://node.example/chunk"]))
+    # all-https chains pass both checks
+    loc._check_redirect(cx, resp(200, "https://node.example/chunk"))
+    loc._check_response_hops(
+        cx, resp(200, "https://node2.example/chunk",
+                 history=["https://node.example/chunk"]))
+    # without the tunable both checks are no-ops
+    open_cx = LocationContext()
+    loc._check_redirect(open_cx, resp(302, "https://node.example/chunk"))
+
+
+def test_plain_context_follows_redirects():
+    """Without https_only, redirects keep working end-to-end."""
+    async def main():
+        node = await FakeHttpNode().start()
+        cx = LocationContext()
+        try:
+            real = Location.parse(node.url + "/real")
+            await real.write(b"payload", cx)
+            via = Location.parse(node.url + "/redir/real")
+            assert await via.read(cx) == b"payload"
+        finally:
+            await cx.aclose()
+            await node.stop()
+
+    asyncio.run(main())
