@@ -99,6 +99,14 @@ def build_parser() -> argparse.ArgumentParser:
                        help="Provide a HTTP Gateway for a cluster")
     p.add_argument("cluster")
     p.add_argument("-l", "--listen-addr", default="127.0.0.1:8000")
+    p.add_argument("--max-put-size", type=int, default=None,
+                   help="Reject PUT bodies larger than this many bytes")
+    p.add_argument("--max-concurrent-puts", type=int, default=32,
+                   help="Bound concurrent PUT ingests; 0 means unbounded "
+                        "(default 32)")
+    p.add_argument("--min-put-rate", type=int, default=256,
+                   help="Abort PUTs averaging below this many bytes/sec "
+                        "after a grace period; 0 disables (default 256)")
 
     p = sub.add_parser("ls", help="List the files in a cluster directory")
     p.add_argument("-r", "--recursive", action="store_true")
@@ -256,7 +264,10 @@ async def _run_command(args, config) -> int:
             raise ChunkyBitsError(
                 f"invalid --listen-addr {args.listen_addr!r} "
                 "(expected host:port)")
-        await serve(cluster, host or "127.0.0.1", int(port))
+        await serve(cluster, host or "127.0.0.1", int(port),
+                    max_put_bytes=args.max_put_size,
+                    max_concurrent_puts=args.max_concurrent_puts,
+                    min_put_rate=args.min_put_rate)
     elif cmd == "ls":
         target = ClusterLocation.parse(args.target)
         if args.recursive:

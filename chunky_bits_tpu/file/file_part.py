@@ -297,7 +297,7 @@ class FilePart:
         pre_digests = digests if digests is not None \
             else [None] * len(payloads)
         chunks = await aio.gather_or_cancel(
-            [asyncio.ensure_future(hash_and_write(pl, w, dg))
+            [hash_and_write(pl, w, dg)
              for pl, w, dg in zip(payloads, writers, pre_digests)])
         return FilePart(
             chunksize=buf_length,
@@ -331,7 +331,7 @@ class FilePart:
                 return (ci, li, ok, None)
 
         jobs = [
-            asyncio.ensure_future(check(ci, chunk, li, location))
+            check(ci, chunk, li, location)
             for ci, chunk in enumerate(self.all_chunks())
             for li, location in enumerate(chunk.locations)
         ]

@@ -101,7 +101,7 @@ class FileReference:
                 return await part.verify(cx)
 
         reports = await aio.gather_or_cancel(
-            [asyncio.ensure_future(one(p)) for p in self.parts])
+            [one(p) for p in self.parts])
         return VerifyFileReport(list(reports))
 
     async def resilver(self, destination,
@@ -124,7 +124,7 @@ class FileReference:
             # on failure siblings are cancelled before the drain below, so
             # no part can submit fresh batcher work after aclose
             reports = await aio.gather_or_cancel(
-                [asyncio.ensure_future(one(p)) for p in self.parts])
+                [one(p) for p in self.parts])
         finally:
             await batcher.aclose()
         return ResilverFileReport(list(reports))

@@ -134,21 +134,6 @@ def default_context() -> LocationContext:
     return _DEFAULT_CONTEXT
 
 
-class _CountingReader:
-    """Pass-through reader that counts bytes consumed, so streaming
-    writes can profile partial progress on failure.  Ownership of the
-    base reader stays with the caller (no close)."""
-
-    def __init__(self, base):
-        self._base = base
-        self.total = 0
-
-    async def read(self, n: int = -1) -> bytes:
-        data = await self._base.read(n)
-        self.total += len(data)
-        return data
-
-
 class _HttpBodyReader:
     """Wraps an aiohttp response body as an AsyncByteReader, closing the
     response at EOF (or on close(), for early-stopping consumers)."""
@@ -491,7 +476,7 @@ class Location:
         start = time.monotonic()
         # Count consumed bytes on the reader side so a stream that fails
         # mid-body still profiles its partial progress.
-        counted = _CountingReader(reader)
+        counted = aio.CountingReader(reader)
         try:
             total = await self._write_from_reader_impl(counted, cx)
         except LocationError as err:

@@ -152,7 +152,7 @@ class FileWriteBuilder:
                     )
 
             await aio.gather_or_cancel(
-                [asyncio.ensure_future(encode_group(*g)) for g in groups])
+                [encode_group(*g) for g in groups])
             return [results[i] for i in range(len(items))]
 
         async def write_part(precomputed) -> FilePart:
@@ -173,7 +173,7 @@ class FileWriteBuilder:
                     sem.release()
                 raise
             return await aio.gather_or_cancel(
-                [asyncio.ensure_future(write_part(x)) for x in pre])
+                [write_part(x) for x in pre])
 
         def flush() -> None:
             """Hand the staged parts to a background encode+write task —
@@ -230,6 +230,11 @@ class FileWriteBuilder:
             nested = await asyncio.gather(*batch_tasks)
             parts = [part for batch in nested for part in batch]
         except BaseException:
+            # Shards already written stay put: they are content-addressed
+            # and may be shared with other files' identical parts, so
+            # blind deletion could destroy durable data.  Orphans are
+            # reclaimed by the reference-checking find-unused-hashes GC
+            # (reference behavior, main.rs:329-435).
             await cancel_all()
             raise
         return FileReference(

@@ -15,6 +15,9 @@ are corrected here.
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import logging
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -22,10 +25,71 @@ from aiohttp import web
 from chunky_bits_tpu.cluster import Cluster
 from chunky_bits_tpu.errors import ChunkyBitsError, MetadataReadError
 from chunky_bits_tpu.file import FileReadBuilder
+from chunky_bits_tpu.utils import aio
+
+log = logging.getLogger("chunky_bits_tpu.gateway")
+
+#: default bound on concurrent PUT ingests; excess requests queue.  The
+#: reference accepts unbounded concurrent ingests (http.rs:97-118) — a
+#: bound is a deliberate hardening for the one component facing
+#: untrusted clients.
+DEFAULT_MAX_CONCURRENT_PUTS = 32
+
+#: a PUT slower than this average (bytes/sec, measured after a grace
+#: window) is aborted with 408: with bounded concurrent ingests, a
+#: trickling client would otherwise hold a slot forever (slow-loris).
+#: 0 disables the floor.
+DEFAULT_MIN_PUT_RATE = 256
+_RATE_GRACE_SECONDS = 30.0
 
 
 class HttpRangeError(ValueError):
     pass
+
+
+class _BodyTooLarge(ChunkyBitsError):
+    pass
+
+
+class _BodyTooSlow(ChunkyBitsError):
+    pass
+
+
+class _GuardedBody(aio.CountingReader):
+    """Request-body reader enforcing the PUT limits: byte cap (via
+    CountingReader) and a minimum average ingest rate.
+
+    The rate floor is a deadline, not a post-read check: each read is
+    bounded by the time left until the cumulative average would drop
+    below ``min_rate``, so a client that sends *nothing at all* (aiohttp
+    has no default body-read timeout) also trips it instead of pinning a
+    PUT slot forever."""
+
+    def __init__(self, content, max_bytes: Optional[int],
+                 min_rate: int):
+        super().__init__(content, max_bytes=max_bytes,
+                         exc_factory=_BodyTooLarge)
+        self._min_rate = min_rate
+        self._started = time.monotonic()
+
+    async def read(self, n: int = -1) -> bytes:
+        if self._min_rate <= 0:
+            return await super().read(n)
+        # Two floors: the cumulative average must stay >= min_rate once
+        # past the grace window (anti-trickle), and no single read may
+        # stall longer than the grace window (anti burst-then-stall — a
+        # client must not bank unbounded credit by front-loading bytes).
+        avg_deadline = (self._started + _RATE_GRACE_SECONDS
+                        + self.total / self._min_rate)
+        timeout = min(_RATE_GRACE_SECONDS,
+                      avg_deadline - time.monotonic())
+        if timeout <= 0:
+            raise _BodyTooSlow(f"ingest below {self._min_rate} B/s")
+        try:
+            return await asyncio.wait_for(super().read(n), timeout)
+        except asyncio.TimeoutError:
+            raise _BodyTooSlow(
+                f"ingest below {self._min_rate} B/s") from None
 
 
 def parse_http_range(s: str):
@@ -58,8 +122,16 @@ def parse_http_range(s: str):
     raise HttpRangeError("no range specified")
 
 
-def make_app(cluster: Cluster) -> web.Application:
+def make_app(cluster: Cluster,
+             max_put_bytes: Optional[int] = None,
+             max_concurrent_puts: int = DEFAULT_MAX_CONCURRENT_PUTS,
+             min_put_rate: int = DEFAULT_MIN_PUT_RATE
+             ) -> web.Application:
     cx = cluster.tunables.location_context()
+    # <=0 means unbounded, like the reference's ingest (and matching
+    # min_put_rate's "0 disables" convention)
+    put_sem = (asyncio.Semaphore(max_concurrent_puts)
+               if max_concurrent_puts > 0 else contextlib.nullcontext())
 
     async def handle_get(request: web.Request) -> web.StreamResponse:
         path = request.match_info["path"]
@@ -67,8 +139,11 @@ def make_app(cluster: Cluster) -> web.Application:
             file_ref = await cluster.get_file_ref(path)
         except MetadataReadError:
             return web.Response(status=404)
-        except ChunkyBitsError:
-            return web.Response(status=500)
+        except ChunkyBitsError as err:
+            # detail goes to the log only: error text can embed internal
+            # node URLs / filesystem paths untrusted clients must not see
+            log.error("GET %s failed: %s", path, err)
+            return web.Response(status=500, text="error: internal error\n")
         builder = FileReadBuilder(file_ref).location_context(cx)
         status = 200
         headers = {}
@@ -118,17 +193,33 @@ def make_app(cluster: Cluster) -> web.Application:
         profile = cluster.get_profile(None)
         content_type: Optional[str] = request.headers.get("Content-Type")
 
-        class _BodyReader:
-            async def read(self, n: int = -1) -> bytes:
-                if n < 0:
-                    return await request.content.read()
-                return await request.content.read(n)
+        if max_put_bytes is not None:
+            declared = request.headers.get("Content-Length")
+            if declared is not None and int(declared) > max_put_bytes:
+                return web.Response(status=413,
+                                    text="error: body too large\n")
 
-        try:
-            await cluster.write_file(
-                path, _BodyReader(), profile, content_type)
-        except ChunkyBitsError:
-            return web.Response(status=500)
+        # A rejected/aborted ingest can leave orphaned shards; they are
+        # content-addressed (possibly shared with other files), so they
+        # are left for the reference-checking find-unused-hashes GC
+        # rather than deleted blindly.
+        async with put_sem:
+            try:
+                await cluster.write_file(
+                    path,
+                    _GuardedBody(request.content, max_put_bytes,
+                                 min_put_rate),
+                    profile, content_type)
+            except _BodyTooLarge:
+                return web.Response(status=413,
+                                    text="error: body too large\n")
+            except _BodyTooSlow:
+                return web.Response(status=408,
+                                    text="error: ingest too slow\n")
+            except ChunkyBitsError as err:
+                log.error("PUT %s failed: %s", path, err)
+                return web.Response(status=500,
+                                    text="error: internal error\n")
         return web.Response(status=200)
 
     app = web.Application()
@@ -138,10 +229,17 @@ def make_app(cluster: Cluster) -> web.Application:
 
 
 async def serve(cluster: Cluster, host: str = "127.0.0.1",
-                port: int = 8000) -> None:
+                port: int = 8000,
+                max_put_bytes: Optional[int] = None,
+                max_concurrent_puts: int = DEFAULT_MAX_CONCURRENT_PUTS,
+                min_put_rate: int = DEFAULT_MIN_PUT_RATE
+                ) -> None:
     """Bind and serve until cancelled (ctrl-c graceful shutdown,
     main.rs:474-485)."""
-    runner = web.AppRunner(make_app(cluster))
+    runner = web.AppRunner(
+        make_app(cluster, max_put_bytes=max_put_bytes,
+                 max_concurrent_puts=max_concurrent_puts,
+                 min_put_rate=min_put_rate))
     await runner.setup()
     site = web.TCPSite(runner, host, port)
     await site.start()

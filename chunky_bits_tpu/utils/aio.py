@@ -70,11 +70,34 @@ async def close_reader(reader) -> None:
             await result
 
 
-async def gather_or_cancel(tasks):
+class CountingReader:
+    """Pass-through reader that counts bytes consumed (``.total``); used
+    to profile partial progress of failed streaming writes and to enforce
+    ingest byte limits.  Ownership of the base reader stays with the
+    caller (no close).  With ``max_bytes`` set, a read pushing the count
+    past the limit raises ``exc_factory()``."""
+
+    def __init__(self, base, max_bytes=None, exc_factory=None):
+        self._base = base
+        self._max_bytes = max_bytes
+        self._exc_factory = exc_factory or (
+            lambda: ValueError("byte limit exceeded"))
+        self.total = 0
+
+    async def read(self, n: int = -1) -> bytes:
+        data = await self._base.read(n)
+        self.total += len(data)
+        if self._max_bytes is not None and self.total > self._max_bytes:
+            raise self._exc_factory()
+        return data
+
+
+async def gather_or_cancel(awaitables):
     """``asyncio.gather`` with fail-fast cleanup: on the first error (or
     outer cancellation) cancel the sibling tasks and await them, so no
     task keeps running in the background with its exception never
-    retrieved.  Returns the results in order."""
+    retrieved.  Accepts coroutines or tasks; returns results in order."""
+    tasks = [asyncio.ensure_future(a) for a in awaitables]
     try:
         return await asyncio.gather(*tasks)
     except BaseException:

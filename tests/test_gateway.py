@@ -168,3 +168,222 @@ def test_gateway_concurrent_puts_coalesce(tmp_path):
         await cluster.tunables.location_context().aclose()
 
     asyncio.run(main())
+
+
+def test_gateway_put_limits_and_errors(tmp_path):
+    """Hardening beyond the reference (http.rs:97-118 maps every failure
+    to a bare 500): 413 on oversized bodies (declared or streamed), error
+    bodies on 500s, and the concurrent-PUT bound holds under load."""
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        cluster = make_cluster(tmp_path)
+        app = make_app(cluster, max_put_bytes=100000, max_concurrent_puts=4)
+        async with TestClient(TestServer(app)) as client:
+            # declared oversize: rejected from the header, before the
+            # streaming ingest starts
+            resp = await client.put("/big", data=b"x" * 200000)
+            assert resp.status == 413
+            # undeclared oversize: chunked stream, caught mid-body
+            async def gen():
+                for _ in range(30):
+                    yield b"y" * 10000
+            resp = await client.put("/big2", data=gen())
+            assert resp.status == 413
+            assert "too large" in await resp.text()
+            # within the limit: accepted
+            resp = await client.put("/ok", data=b"z" * 50000)
+            assert resp.status == 200
+            # no metadata was durably written for the rejected bodies
+            assert not (tmp_path / "meta" / "big").exists()
+            assert not (tmp_path / "meta" / "big2").exists()
+
+    asyncio.run(main())
+
+
+def test_gateway_put_concurrency_bound(tmp_path, monkeypatch):
+    """At most max_concurrent_puts ingests run at once; the rest queue
+    and complete."""
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        cluster = make_cluster(tmp_path)
+        in_flight = {"now": 0, "peak": 0}
+        real_write = Cluster.write_file
+
+        async def counting_write(self, path, reader, profile,
+                                 content_type=None, **kw):
+            in_flight["now"] += 1
+            in_flight["peak"] = max(in_flight["peak"], in_flight["now"])
+            try:
+                await asyncio.sleep(0.01)
+                return await real_write(self, path, reader, profile,
+                                        content_type, **kw)
+            finally:
+                in_flight["now"] -= 1
+
+        monkeypatch.setattr(Cluster, "write_file", counting_write)
+        app = make_app(cluster, max_concurrent_puts=3)
+        async with TestClient(TestServer(app)) as client:
+            resps = await asyncio.gather(*[
+                client.put(f"/obj{i}", data=os.urandom(20000))
+                for i in range(12)
+            ])
+            assert all(r.status == 200 for r in resps)
+        assert in_flight["peak"] <= 3
+        assert in_flight["peak"] > 1  # genuinely concurrent
+
+    asyncio.run(main())
+
+
+def test_gateway_concurrent_puts_and_ranged_gets_stress(tmp_path):
+    """Mixed load: concurrent PUTs of distinct objects while ranged GETs
+    stream an existing object; every byte must come back right."""
+    payload = os.urandom(400000)
+
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        cluster = make_cluster(tmp_path)
+        app = make_app(cluster)
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.put("/base", data=payload)
+            assert resp.status == 200
+
+            async def put_one(i):
+                body = os.urandom(60000 + i * 1000)
+                r = await client.put(f"/stress{i}", data=body)
+                assert r.status == 200
+                return (i, body)
+
+            async def get_range(i):
+                start = (i * 37003) % (len(payload) - 5000)
+                end = start + 4999
+                r = await client.get(
+                    "/base", headers={"Range": f"bytes={start}-{end}"})
+                assert r.status == 206
+                assert await r.read() == payload[start:end + 1]
+
+            puts, _ = await asyncio.gather(
+                asyncio.gather(*[put_one(i) for i in range(8)]),
+                asyncio.gather(*[get_range(i) for i in range(16)]),
+            )
+            for i, body in puts:
+                r = await client.get(f"/stress{i}")
+                assert await r.read() == body
+
+    asyncio.run(main())
+
+
+def test_gateway_oversize_put_orphans_are_gc_collectable(tmp_path):
+    """A mid-stream 413 leaves no metadata; shards already written stay
+    (they are content-addressed and possibly shared with other files, so
+    blind deletion would be a data-destruction primitive) and the
+    reference-checking find-unused-hashes GC reclaims them."""
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from chunky_bits_tpu.cli.main import main as cli_main
+
+        cluster = make_cluster(tmp_path)
+        app = make_app(cluster, max_put_bytes=200000)
+        async with TestClient(TestServer(app)) as client:
+            # a durable object first
+            resp = await client.put("/keep", data=b"k" * 150000)
+            assert resp.status == 200
+
+            async def gen():
+                for _ in range(60):  # 600 KB chunked, no Content-Length
+                    yield b"y" * 10000
+            resp = await client.put("/leak", data=gen())
+            assert resp.status == 413
+        assert not (tmp_path / "meta" / "leak").exists()
+        # write the cluster spec out so the GC CLI can run against it
+        spec = tmp_path / "cluster.yaml"
+        spec.write_text(yaml.safe_dump(cluster.to_obj()))
+        rc = await asyncio.to_thread(
+            cli_main,
+            ["find-unused-hashes", "--remove", f"{spec}#.", "--",
+             *[str(tmp_path / f"disk{i}") for i in range(5)]])
+        assert rc == 0
+        # orphans gone, durable object intact
+        ref = await cluster.get_file_ref("keep")
+        report = await ref.verify()
+        assert report.is_ideal()
+        referenced = {str(c.hash) for p in ref.parts
+                      for c in (*p.data, *p.parity)}
+        remaining = {p.name for i in range(5)
+                     for p in (tmp_path / f"disk{i}").iterdir()}
+        assert remaining == referenced
+
+    asyncio.run(main())
+
+
+def test_guarded_body_rate_floor(monkeypatch):
+    """The minimum-ingest-rate guard aborts a trickling body once past
+    the grace window (slow-loris cannot pin a PUT slot forever)."""
+    from chunky_bits_tpu.gateway import http as gw
+
+    class Trickle:
+        async def read(self, n=-1):
+            return b"z"
+
+    clock = {"now": 0.0}
+    monkeypatch.setattr(gw.time, "monotonic", lambda: clock["now"])
+    body = gw._GuardedBody(Trickle(), max_bytes=None, min_rate=256)
+
+    async def main():
+        # inside the grace window: slow reads are tolerated
+        clock["now"] = gw._RATE_GRACE_SECONDS - 1
+        assert await body.read(1024) == b"z"
+        # past the grace window at ~0 B/s average: aborted before even
+        # waiting on the client
+        clock["now"] = gw._RATE_GRACE_SECONDS + 10
+        with pytest.raises(gw._BodyTooSlow):
+            await body.read(1024)
+        # min_rate=0 disables the floor entirely
+        fast = gw._GuardedBody(Trickle(), max_bytes=None, min_rate=0)
+        clock["now"] = 10_000.0
+        assert await fast.read(1024) == b"z"
+
+    asyncio.run(main())
+
+
+def test_guarded_body_silent_client_times_out(monkeypatch):
+    """A client that sends headers and then *nothing* is also aborted:
+    the rate floor is a read deadline, not a post-read check."""
+    from chunky_bits_tpu.gateway import http as gw
+
+    class Silent:
+        async def read(self, n=-1):
+            await asyncio.Future()  # never resolves
+
+    monkeypatch.setattr(gw, "_RATE_GRACE_SECONDS", 0.05)
+
+    async def main():
+        body = gw._GuardedBody(Silent(), max_bytes=None, min_rate=256)
+        with pytest.raises(gw._BodyTooSlow):
+            await body.read(1024)
+
+    asyncio.run(main())
+
+
+def test_guarded_body_burst_then_stall_cannot_bank_credit(monkeypatch):
+    """Bytes already sent must not buy an unbounded stall: a read can
+    never wait longer than the grace window, however fast the client
+    burst beforehand."""
+    from chunky_bits_tpu.gateway import http as gw
+
+    monkeypatch.setattr(gw, "_RATE_GRACE_SECONDS", 0.05)
+
+    class Silent:
+        async def read(self, n=-1):
+            await asyncio.Future()  # never resolves
+
+    async def main():
+        body = gw._GuardedBody(Silent(), max_bytes=None, min_rate=1)
+        body.total = 10 ** 9  # credit banked by a line-speed burst
+        with pytest.raises(gw._BodyTooSlow):
+            await body.read(1024)
+
+    asyncio.run(main())
