@@ -1,0 +1,105 @@
+"""Regenerate the golden file-reference fixtures.
+
+Run from the repo root:  python tests/golden/generate.py
+
+The fixtures freeze bytes -> exact YAML (structure, sha256 content
+addresses, parity hashes, and for the cluster fixture the hash-seeded
+placement) as cross-version conformance anchors: a future kernel or
+layout change that silently breaks wire compatibility fails
+tests/test_golden.py.  Regenerating is a deliberate act — do it only for
+an intentional, documented format change.
+"""
+
+import asyncio
+import os
+import sys
+import tempfile
+
+import numpy as np
+import yaml
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from chunky_bits_tpu.cluster import Cluster  # noqa: E402
+from chunky_bits_tpu.file import FileWriteBuilder  # noqa: E402
+from chunky_bits_tpu.utils import aio  # noqa: E402
+
+GOLDEN_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def payload(n: int, seed: int) -> bytes:
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+def cluster_spec(meta_path: str) -> dict:
+    """Relative-path destinations with unequal weights; placement is
+    deterministic because the cluster Destination seeds its RNG from the
+    first shard hash (reference: src/cluster/destination.rs:73-84)."""
+    return {
+        "destinations": [
+            {"location": "d0", "weight": 2000},
+            {"location": "d1", "weight": 500},
+            {"location": "d2"},
+            {"location": "d3"},
+            {"location": "d4", "repeat": 1},
+        ],
+        "metadata": {"type": "path", "format": "yaml", "path": meta_path},
+        "profiles": {"default": {"data": 3, "parity": 2,
+                                 "chunk_size": 12}},
+    }
+
+
+async def build_refs() -> dict[str, dict]:
+    refs: dict[str, dict] = {}
+
+    # 1. structure + content addressing, short final part (d=3 p=2)
+    ref = await (FileWriteBuilder()
+                 .with_chunk_size(1 << 14)
+                 .with_data_chunks(3).with_parity_chunks(2)
+                 .write(aio.BytesReader(payload(100_000, 1))))
+    refs["void_small"] = ref.to_obj()
+
+    # 2. the benchmark geometry d=10 p=4: parity hashes pin the GF(2^8)
+    # matrix convention byte-for-byte across backends
+    ref = await (FileWriteBuilder()
+                 .with_chunk_size(1 << 12)
+                 .with_data_chunks(10).with_parity_chunks(4)
+                 .write(aio.BytesReader(payload(3 * 10 * (1 << 12) + 777,
+                                               2))))
+    refs["void_wide"] = ref.to_obj()
+
+    # 3. hash-seeded weighted placement over relative-path destinations
+    with tempfile.TemporaryDirectory() as tmp:
+        cwd = os.getcwd()
+        os.chdir(tmp)
+        try:
+            for i in range(5):
+                os.mkdir(f"d{i}")
+            os.mkdir("meta")
+            cluster = Cluster.from_obj(cluster_spec("meta"))
+            profile = cluster.get_profile()
+            ref = await (cluster.get_file_writer(profile)
+                         .write(aio.BytesReader(payload(30_000, 3))))
+            refs["cluster_placement"] = ref.to_obj()
+        finally:
+            os.chdir(cwd)
+    return refs
+
+
+def dump(obj: dict) -> str:
+    return yaml.safe_dump(obj, sort_keys=False)
+
+
+def main() -> None:
+    refs = asyncio.run(build_refs())
+    for name, obj in refs.items():
+        path = os.path.join(GOLDEN_DIR, f"{name}.yaml")
+        with open(path, "w") as f:
+            f.write(dump(obj))
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

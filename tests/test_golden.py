@@ -1,0 +1,52 @@
+"""Golden file-reference conformance anchors.
+
+Each fixture under tests/golden/ freezes bytes -> exact YAML: structure,
+sha256 content addresses (so the GF(2^8) parity bytes are pinned through
+their hashes), and for the cluster fixture the hash-seeded weighted
+placement.  A kernel, layout, or serialization change that silently
+breaks wire compatibility fails here; regenerate deliberately with
+``python tests/golden/generate.py`` only for an intentional format
+change.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from tests.golden import generate as gen
+
+
+def golden_text(name: str) -> str:
+    with open(os.path.join(gen.GOLDEN_DIR, f"{name}.yaml")) as f:
+        return f.read()
+
+
+def test_fixtures_match_current_behavior():
+    refs = asyncio.run(gen.build_refs())
+    assert set(refs) == {"void_small", "void_wide", "cluster_placement"}
+    for name, obj in refs.items():
+        assert gen.dump(obj) == golden_text(name), (
+            f"golden fixture {name} drifted — wire compatibility broken "
+            "(or an intentional change: regenerate via "
+            "tests/golden/generate.py and document it)")
+
+
+@pytest.mark.parametrize("backend", ["numpy", "native", "jax"])
+def test_wide_fixture_backend_byte_identity(backend):
+    """Every erasure backend must reproduce the frozen d=10 p=4 reference
+    exactly — parity hashes pin the matrix convention byte-for-byte."""
+    from chunky_bits_tpu.file import FileWriteBuilder
+    from chunky_bits_tpu.utils import aio
+
+    async def build():
+        return await (FileWriteBuilder()
+                      .with_chunk_size(1 << 12)
+                      .with_data_chunks(10).with_parity_chunks(4)
+                      .with_backend(backend)
+                      .with_batch_parts(2)
+                      .write(aio.BytesReader(
+                          gen.payload(3 * 10 * (1 << 12) + 777, 2))))
+
+    ref = asyncio.run(build())
+    assert gen.dump(ref.to_obj()) == golden_text("void_wide")

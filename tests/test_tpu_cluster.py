@@ -78,13 +78,7 @@ def test_jax_backend_cluster_lifecycle(tmp_path):
         os.remove(part.data[0].locations[0].target)
         os.remove(part.data[1].locations[0].target)
         reader = await cluster.read_file("f")
-        got = []
-        while True:
-            b = await reader.read(1 << 16)
-            if not b:
-                break
-            got.append(b)
-        assert b"".join(got) == payload
+        assert await read_all(reader) == payload
 
         report = await ref.resilver(
             cluster.get_destination(profile), backend="jax")
@@ -204,9 +198,14 @@ def test_mesh_resilver_coalesces_parts_per_dispatch(
             os.remove(part.data[0].locations[0].target)
             os.remove(part.parity[0].locations[0].target)
 
-        # degraded read through the mesh backend, batched across parts
+        # degraded read through the mesh backend: all prefetched parts
+        # must share ONE batcher (coalescing is opportunistic, so the
+        # dispatch count is timing-dependent — the shared-instance
+        # invariant is the deterministic part)
         reader = await cluster.read_file("m")
         assert await read_all(reader) == payload
+        assert len(captured) == 1, (
+            "read stream no longer shares a single ReconstructBatcher")
 
         # resilver through the mesh backend; the shared batcher must
         # coalesce the 8 same-pattern parts into fewer dispatches
