@@ -123,14 +123,34 @@ class JaxBackend(ErasureBackend):
                 self._on_tpu = False
         m2 = self._bit_matrix(mat)
         fn = _jitted_apply()
-        # Block the batch axis so the 16x bit expansion fits device memory.
+        # Block the batch axis so the 16x bit expansion fits device memory
+        # (halved: the double-buffered pipeline keeps 2 blocks in flight).
         per_item = k * s * 16
-        block = max(1, self.max_block_bytes // max(per_item, 1))
+        block = max(1, self.max_block_bytes // 2 // max(per_item, 1))
+        return self._pipelined_blocks(lambda dev: fn(m2, dev),
+                                      shards, block)
+
+    def _pipelined_blocks(self, dispatch, shards: np.ndarray,
+                          block: int) -> np.ndarray:
+        """Run ``dispatch`` over batch blocks with H2D/compute overlap:
+        jax dispatch is asynchronous, so issuing block N+1's device_put
+        and kernel before materializing block N's result lets the next
+        host->device transfer (and compute) proceed while the host blocks
+        on the previous device->host copy.  Two blocks in flight — the
+        classic double buffer."""
+        jax, _ = _ensure_jax()
+        b = shards.shape[0]
+        if b <= block:
+            return np.asarray(dispatch(jax.device_put(shards)))
         outs = []
+        pending = []
         for lo in range(0, b, block):
-            chunk = jnp.asarray(shards[lo:lo + block])
-            outs.append(np.asarray(fn(m2, chunk)))
-        return np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+            dev = jax.device_put(np.ascontiguousarray(shards[lo:lo + block]))
+            pending.append(dispatch(dev))
+            if len(pending) > 1:
+                outs.append(np.asarray(pending.pop(0)))
+        outs.extend(np.asarray(o) for o in pending)
+        return np.concatenate(outs, axis=0)
 
     #: the fused kernel keeps bits in VMEM, so its device footprint is just
     #: data + parity; a much larger per-dispatch budget applies.
@@ -141,11 +161,6 @@ class JaxBackend(ErasureBackend):
 
         b, k, s = shards.shape
         per_item = k * s * 2
-        block = max(1, self.max_pallas_block_bytes // max(per_item, 1))
-        if block >= b:
-            return np.asarray(apply_matrix_pallas(mat, shards))
-        outs = []
-        for lo in range(0, b, block):
-            outs.append(np.asarray(
-                apply_matrix_pallas(mat, shards[lo:lo + block])))
-        return np.concatenate(outs, axis=0)
+        block = max(1, self.max_pallas_block_bytes // 2 // max(per_item, 1))
+        return self._pipelined_blocks(
+            lambda dev: apply_matrix_pallas(mat, dev), shards, block)

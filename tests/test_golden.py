@@ -39,6 +39,14 @@ def test_wide_fixture_backend_byte_identity(backend):
     from chunky_bits_tpu.file import FileWriteBuilder
     from chunky_bits_tpu.utils import aio
 
+    if backend == "native":
+        from chunky_bits_tpu.ops.backend import get_backend
+
+        try:
+            get_backend("native")
+        except Exception as err:  # pragma: no cover - missing g++
+            pytest.skip(f"native backend unavailable: {err}")
+
     async def build():
         return await (FileWriteBuilder()
                       .with_chunk_size(1 << 12)
