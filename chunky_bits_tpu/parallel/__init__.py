@@ -8,3 +8,9 @@ from chunky_bits_tpu.parallel.mesh import (  # noqa: F401
     sharded_apply,
     wide_apply_sharded,
 )
+from chunky_bits_tpu.parallel.multihost import (  # noqa: F401
+    init_multihost,
+    local_mesh,
+    local_stripe_mesh,
+    partition_parts,
+)

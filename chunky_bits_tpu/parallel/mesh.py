@@ -46,11 +46,13 @@ def _shard_map():
     return shard_map
 
 
-def _make_mesh_2d(n_devices, first, first_name, second, second_name):
+def _make_mesh_2d(n_devices, first, first_name, second, second_name,
+                  devices=None):
     import jax
     from jax.sharding import Mesh
 
-    devices = jax.devices()
+    if devices is None:
+        devices = jax.devices()
     n = n_devices or len(devices)
     if n > len(devices):
         raise ValueError(
@@ -72,16 +74,18 @@ def _make_mesh_2d(n_devices, first, first_name, second, second_name):
 
 
 def make_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None,
-              sp: Optional[int] = None):
-    """Build a ('dp', 'sp') mesh over the first n devices."""
-    return _make_mesh_2d(n_devices, dp, "dp", sp, "sp")
+              sp: Optional[int] = None, devices=None):
+    """Build a ('dp', 'sp') mesh over the first n of ``devices``
+    (default: all global devices)."""
+    return _make_mesh_2d(n_devices, dp, "dp", sp, "sp", devices=devices)
 
 
 def make_stripe_mesh(n_devices: Optional[int] = None,
-                     dp: Optional[int] = None, tp: Optional[int] = None):
+                     dp: Optional[int] = None, tp: Optional[int] = None,
+                     devices=None):
     """Build a ('dp', 'tp') mesh for wide-stripe (contraction-sharded)
     encode/decode; ``tp`` must divide the stripe's data-shard count."""
-    return _make_mesh_2d(n_devices, dp, "dp", tp, "tp")
+    return _make_mesh_2d(n_devices, dp, "dp", tp, "tp", devices=devices)
 
 
 from chunky_bits_tpu.ops.bitplane import apply_bitplane as _apply_local

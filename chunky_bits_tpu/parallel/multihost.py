@@ -1,0 +1,138 @@
+"""Multi-host bootstrap for the distributed compute plane.
+
+Scaling stance (the erasure analogue of the reference's NCCL/MPI
+question — it has none; its fabric is HTTP between storage nodes,
+src/cluster/writer.rs): Reed-Solomon parts are *independent* stripes, so
+the part-batch axis ('dp') is embarrassingly parallel and the compute
+plane never needs a cross-host collective.  The layout that follows:
+
+* **DCN (between hosts)** carries only the object plane — HTTP shard
+  reads/writes and metadata, exactly like the reference — plus the
+  one-time jax.distributed control handshake.
+* **ICI (within a host's slice)** carries the only collectives the math
+  has: the wide-stripe 'tp' psum and the 'sp' byte split
+  (parallel/mesh.py).  Meshes are therefore built over
+  ``jax.local_devices()`` — each process encodes its own slice of parts
+  on its own chips.
+
+``init_multihost`` wires processes into one jax.distributed job (so
+device/process topology is queryable and future cross-host work — e.g.
+replicating hot bit-matrices — can use global arrays), and
+``partition_parts`` deals the part batch across processes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["init_multihost", "local_mesh", "local_stripe_mesh",
+           "partition_parts"]
+
+_INITIALIZED = False
+
+
+def init_multihost(coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None,
+                   auto: bool = False) -> tuple[int, int]:
+    """Join (or detect) the multi-host jax job; idempotent.
+
+    Three ways in, checked in order:
+
+    1. explicit args (any of ``coordinator_address``/``num_processes``/
+       ``process_id``) — passed straight to ``jax.distributed.initialize``;
+       initialization failures propagate, and explicit args after this
+       process has already been finalized single-process raise instead of
+       being silently ignored;
+    2. the ``JAX_COORDINATOR_ADDRESS``/``COORDINATOR_ADDRESS`` env var;
+    3. ``auto=True`` — jax's cluster auto-detection (Cloud TPU pods,
+       GKE, Slurm); only on request because on a plain host it raises.
+
+    With none of these it is a no-op single-process setup, so the same
+    code path runs unchanged on one host.  Returns
+    ``(process_index, process_count)``.
+    """
+    global _INITIALIZED
+    import jax
+
+    # Decide from args/env alone — jax.process_count() would initialize
+    # the backends, after which jax.distributed.initialize refuses to run.
+    explicit = (coordinator_address is not None
+                or num_processes is not None
+                or process_id is not None)
+    env_coordinator = (os.environ.get("JAX_COORDINATOR_ADDRESS")
+                       or os.environ.get("COORDINATOR_ADDRESS"))
+
+    if jax.distributed.is_initialized():
+        _INITIALIZED = True
+        return jax.process_index(), jax.process_count()
+
+    if _INITIALIZED:
+        if explicit:
+            raise RuntimeError(
+                "init_multihost() already finalized this process as "
+                "single-host; pass coordinator args on the first call")
+        return jax.process_index(), jax.process_count()
+
+    if explicit:
+        kwargs = {}
+        if coordinator_address is not None:
+            kwargs["coordinator_address"] = coordinator_address
+        if num_processes is not None:
+            kwargs["num_processes"] = num_processes
+        if process_id is not None:
+            kwargs["process_id"] = process_id
+        jax.distributed.initialize(**kwargs)
+    elif env_coordinator is not None:
+        jax.distributed.initialize(coordinator_address=env_coordinator)
+    elif auto:
+        jax.distributed.initialize()
+    _INITIALIZED = True
+    return jax.process_index(), jax.process_count()
+
+
+def local_mesh(dp: Optional[int] = None, sp: Optional[int] = None):
+    """('dp', 'sp') mesh over THIS process's devices (ICI domain only).
+
+    The cross-host axis is the object plane, not the mesh: each process
+    gets its own mesh and its own slice of parts (``partition_parts``).
+    """
+    import jax
+
+    from chunky_bits_tpu.parallel.mesh import make_mesh
+
+    local = jax.local_devices()
+    return make_mesh(len(local), dp=dp, sp=sp, devices=local)
+
+
+def local_stripe_mesh(dp: Optional[int] = None, tp: Optional[int] = None):
+    """('dp', 'tp') wide-stripe mesh over this process's devices; the
+    'tp' psum rides ICI and never crosses DCN."""
+    import jax
+
+    from chunky_bits_tpu.parallel.mesh import make_stripe_mesh
+
+    local = jax.local_devices()
+    return make_stripe_mesh(len(local), dp=dp, tp=tp, devices=local)
+
+
+def partition_parts(total_parts: int,
+                    process_index: Optional[int] = None,
+                    process_count: Optional[int] = None) -> tuple[int, int]:
+    """Deal a global part batch across processes: the ``[start, stop)``
+    slice this process encodes.  Contiguous balanced slices (first
+    ``total % n`` processes take one extra part), so the ordered
+    metadata assembly of writer.py concatenates host results without
+    reshuffling.
+    """
+    import jax
+
+    n = process_count if process_count is not None else jax.process_count()
+    i = process_index if process_index is not None else jax.process_index()
+    if not 0 <= i < n:
+        raise ValueError(f"process_index {i} outside 0..{n - 1}")
+    base, extra = divmod(total_parts, n)
+    start = i * base + min(i, extra)
+    stop = start + base + (1 if i < extra else 0)
+    return start, stop

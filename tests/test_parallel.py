@@ -265,3 +265,78 @@ def test_mesh_backend_name_normalization(eight_devices):
     from chunky_bits_tpu.errors import ErasureError
     with pytest.raises(ErasureError, match="devices"):
         get_backend("jax:dp64,sp2")
+
+
+def test_multihost_single_process_is_noop():
+    """init_multihost without a coordinator is a clean single-process
+    setup; local meshes span exactly this process's devices and run the
+    sharded step."""
+    import jax
+
+    from chunky_bits_tpu.ops import matrix
+    from chunky_bits_tpu.ops.backend import ErasureCoder, NumpyBackend
+    from chunky_bits_tpu.parallel import (
+        encode_step_sharded,
+        init_multihost,
+        local_mesh,
+        partition_parts,
+    )
+
+    idx, count = init_multihost()
+    assert (idx, count) == (0, 1)
+    idx, count = init_multihost()  # idempotent
+    assert (idx, count) == (0, 1)
+
+    mesh = local_mesh(sp=2)
+    assert mesh.devices.size == len(jax.local_devices())
+
+    d, p = 4, 2
+    enc = matrix.build_encode_matrix(d, p)
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, (8, d, 512), dtype=np.uint8)
+    lo, hi = partition_parts(len(data))
+    assert (lo, hi) == (0, len(data))  # one process owns everything
+    parity, _ = encode_step_sharded(mesh, enc, data[lo:hi])
+    want = ErasureCoder(d, p, NumpyBackend()).encode_batch(data)
+    assert np.array_equal(np.asarray(parity), want)
+
+
+def test_partition_parts_deals_balanced_contiguous_slices():
+    from chunky_bits_tpu.parallel import partition_parts
+
+    for total, n in [(10, 4), (8, 8), (3, 8), (0, 4), (257, 16)]:
+        slices = [partition_parts(total, i, n) for i in range(n)]
+        # contiguous, ordered, covering exactly [0, total)
+        assert slices[0][0] == 0 and slices[-1][1] == total
+        for (a, b), (c, e) in zip(slices, slices[1:]):
+            assert b == c
+        sizes = [b - a for a, b in slices]
+        assert max(sizes) - min(sizes) <= 1  # balanced
+
+    with pytest.raises(ValueError):
+        partition_parts(10, 5, 4)
+
+
+def test_local_mesh_uses_local_devices():
+    """The local meshes are built from jax.local_devices(), not a count
+    sliced off the global list — on a process_index>0 host those differ
+    and collectives would otherwise cross DCN."""
+    import jax
+
+    from chunky_bits_tpu.parallel import local_mesh, local_stripe_mesh
+
+    local = set(jax.local_devices())
+    for mesh in (local_mesh(sp=2), local_stripe_mesh(tp=2)):
+        assert set(mesh.devices.flat) == local
+
+
+def test_init_multihost_rejects_late_explicit_args():
+    """Explicit coordinator args after the process was finalized
+    single-host must raise, not be silently ignored."""
+    import chunky_bits_tpu.parallel.multihost as mh
+
+    mh.init_multihost()  # finalize single-process
+    with pytest.raises(RuntimeError, match="already finalized"):
+        mh.init_multihost("router:1234", num_processes=4, process_id=1)
+    with pytest.raises(RuntimeError, match="already finalized"):
+        mh.init_multihost(process_id=2)  # lone process_id is explicit too
