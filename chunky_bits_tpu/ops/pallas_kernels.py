@@ -119,17 +119,131 @@ def _build_kernel(r: int, k: int, tile_s: int, bblock: int, interpret: bool):
     return jax.jit(call)
 
 
-def _pick_tile(s: int, k: int) -> int:
+@functools.lru_cache(maxsize=32)
+def _build_acc_kernel(r: int, k: int, tile_s: int, bblock: int,
+                      interpret: bool):
+    """Like ``_build_kernel`` but stops before the mod-2/pack: emits the
+    raw int32 popcount accumulator [B, R8, S].  This is the per-chip half
+    of the contraction-sharded (tp) mesh path — partial popcounts from
+    different chips *add* (GF(2^8) addition is XOR), so the mesh layer can
+    ``psum`` these over ICI and apply one mod-2/pack after the collective
+    (parallel/mesh.py)."""
+    jax = _jx()
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r8, k8 = r * 8, k * 8
+
+    def kernel(m2_ref, data_ref, out_ref, bits_ref):
+        for bi in range(bblock):
+            data = data_ref[bi].astype(jnp.int32)  # [K, TS]
+            for b in range(8):
+                bits_ref[b * k:(b + 1) * k, :] = (
+                    (data >> b) & 1
+                ).astype(jnp.int8)
+            out_ref[bi] = jax.lax.dot_general(
+                m2_ref[...], bits_ref[...],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )  # [R8, TS]
+
+    def call(m2, data):
+        batch, _k, s = data.shape
+        grid = (batch // bblock, s // tile_s)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((r8, k8), lambda b, j: (0, 0)),
+                pl.BlockSpec((bblock, k, tile_s), lambda b, j: (b, 0, j)),
+            ],
+            out_specs=pl.BlockSpec((bblock, r8, tile_s),
+                                   lambda b, j: (b, 0, j)),
+            out_shape=jax.ShapeDtypeStruct((batch, r8, s), jnp.int32),
+            scratch_shapes=[pltpu.VMEM((k8, tile_s), jnp.int8)],
+            interpret=interpret,
+        )(m2, data)
+
+    return jax.jit(call)
+
+
+def _pick_tile(s: int, k: int, row_bytes: int = 0) -> int:
     """Largest power-of-two tile dividing s, capped so the int8 bit-plane
     scratch (k*8 rows x tile lanes) stays within ~4 MiB of VMEM (s must be
     a multiple of 128 for the fast path; 32 KiB tiles measured fastest at
-    d=10)."""
+    d=10).  ``row_bytes`` adds a per-lane VMEM cost for the output block
+    (the int32 accumulator of the acc kernel), capped at ~6 MiB."""
     tile = 32768
     while tile > 128 and tile * k * 8 > (4 << 20):
+        tile //= 2
+    while tile > 128 and row_bytes and tile * row_bytes > (6 << 20):
         tile //= 2
     while tile > 128 and s % tile != 0:
         tile //= 2
     return tile if s % tile == 0 else 0
+
+
+def apply_m2_bitmajor(m2, shards, *, interpret: bool = False):
+    """Fused transform over an already-built bit-major int8 device matrix.
+
+    The traceable core of ``apply_matrix_pallas``: usable inside
+    ``shard_map`` local functions (parallel/mesh.py), where the matrix
+    arrives as a device argument and shapes are static at trace time.
+    ``m2`` is int8 [R*8, K*8] from ``bit_matrix_bitmajor``; ``shards`` is
+    uint8 [B, K, S].  Raises ValueError when shapes don't fit the fast
+    path.
+    """
+    r8, k8 = m2.shape
+    r, k = r8 // 8, k8 // 8
+    b, k2, s = shards.shape
+    assert k2 == k
+    tile = _pick_tile(s, k)
+    if tile == 0 or r == 0:
+        raise ValueError(f"shard size {s} not tileable for pallas path")
+    bblock = 2 if b % 2 == 0 else 1
+    fn = _build_kernel(r, k, tile, bblock, interpret)
+    return fn(m2, shards)
+
+
+def acc_m2_bitmajor(m2, shards, *, interpret: bool = False):
+    """Partial bit-plane accumulation (pre mod-2), bit-major rows:
+    int32 [B, R*8, S].  Per-chip half of the tp-sharded mesh encode."""
+    r8, k8 = m2.shape
+    r, k = r8 // 8, k8 // 8
+    b, k2, s = shards.shape
+    assert k2 == k
+    bblock = 2 if b % 2 == 0 else 1
+    tile = _pick_tile(s, k, row_bytes=r8 * 4 * bblock)
+    if tile == 0 or r == 0:
+        raise ValueError(f"shard size {s} not tileable for pallas path")
+    fn = _build_acc_kernel(r, k, tile, bblock, interpret)
+    return fn(m2, shards)
+
+
+def pack_acc_bitmajor(acc):
+    """Pack int32 bit-major popcounts [B, R*8, S] into bytes [B, R, S]:
+    row ``b*R + i`` is bit b of output byte-row i (the layout
+    ``bit_matrix_bitmajor`` produces), so the mod-2 bits of plane b land
+    at bit position b of byte i."""
+    import jax.numpy as jnp
+
+    b, r8, s = acc.shape
+    r = r8 // 8
+    bits = (acc & 1).reshape(b, 8, r, s)
+    shifts = jnp.arange(8, dtype=jnp.int32)
+    return jnp.sum(bits << shifts[None, :, None, None],
+                   axis=1).astype(jnp.uint8)
+
+
+def bitmajor_device_matrix(mat: np.ndarray):
+    """The int8 bit-major device matrix for a GF matrix [R, K] (host
+    expansion cached; the tiny host->device copy happens per call)."""
+    import jax.numpy as jnp
+
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    return jnp.asarray(_host_matrix(mat.tobytes(), *mat.shape),
+                       dtype=jnp.int8)
 
 
 def apply_matrix_pallas(mat: np.ndarray, shards, *, interpret: bool = False):
@@ -140,17 +254,7 @@ def apply_matrix_pallas(mat: np.ndarray, shards, *, interpret: bool = False):
     array [B, R, S].  Raises ValueError when shapes don't fit the fast
     path (caller falls back to the einsum path).
     """
-    jax = _jx()
     import jax.numpy as jnp
 
-    r, k = mat.shape
-    b, k2, s = shards.shape
-    assert k2 == k
-    tile = _pick_tile(s, k)
-    if tile == 0 or r == 0:
-        raise ValueError(f"shard size {s} not tileable for pallas path")
-    mat = np.ascontiguousarray(mat, dtype=np.uint8)
-    m2 = jnp.asarray(_host_matrix(mat.tobytes(), r, k), dtype=jnp.int8)
-    bblock = 2 if b % 2 == 0 else 1
-    fn = _build_kernel(r, k, tile, bblock, interpret)
-    return fn(m2, jnp.asarray(shards))
+    return apply_m2_bitmajor(bitmajor_device_matrix(mat),
+                             jnp.asarray(shards), interpret=interpret)

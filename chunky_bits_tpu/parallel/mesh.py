@@ -46,6 +46,25 @@ def _shard_map():
     return shard_map
 
 
+def _smap(fn, *, mesh, in_specs, out_specs, impl="einsum"):
+    """shard_map with the varying-axes checker disabled for the pallas
+    impls: pallas_call's out_shape carries no vma annotation, so jax's
+    check_vma rejects it; the out_specs here are explicit and the psum
+    lowers to the same collective either way."""
+    import inspect
+
+    sm = _shard_map()
+    kwargs = {}
+    if impl != "einsum":
+        params = inspect.signature(sm).parameters
+        if "check_vma" in params:
+            kwargs["check_vma"] = False
+        elif "check_rep" in params:
+            kwargs["check_rep"] = False
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              **kwargs)
+
+
 def _make_mesh_2d(n_devices, first, first_name, second, second_name,
                   devices=None):
     import jax
@@ -108,56 +127,127 @@ def _device_bit_matrix(mat_bytes: bytes, r: int, k: int):
                        dtype=jnp.bfloat16)
 
 
-@functools.lru_cache(maxsize=16)
-def _sharded_apply_fn(mesh):
-    """Jitted shard_mapped transform, cached per mesh so repeated calls
-    reuse the XLA executable instead of retracing."""
+# ---------------------------------------------------------------------------
+# Per-chip transform implementations.
+#
+# On a TPU mesh each chip runs the fused Pallas kernel
+# (ops/pallas_kernels.py — unpack/MXU-matmul/pack entirely in VMEM, the
+# same kernel that hits ~55 GiB/s single-chip), so the mesh path carries
+# the single-chip roofline instead of falling back to the HBM-bound einsum
+# expansion.  CPU meshes (the virtual 8-device test mesh) keep the einsum;
+# "pallas_interpret" runs the kernel's interpret mode so the wiring is
+# testable off-TPU.
+# ---------------------------------------------------------------------------
+
+_IMPLS = ("einsum", "pallas", "pallas_interpret")
+
+
+def _check_impl(impl: str) -> None:
+    if impl not in _IMPLS:
+        raise ValueError(f"unknown mesh impl {impl!r} (want one of {_IMPLS})")
+
+
+def _auto_impl(mesh, r: int, k_local: int, s_local: int) -> str:
+    """Pick the per-chip transform: the fused Pallas kernel when the mesh
+    lives on TPU chips and the local block fits its fast path, else the
+    einsum bit-plane expansion."""
+    from chunky_bits_tpu.ops.pallas_kernels import _pick_tile
+
+    try:
+        on_tpu = mesh.devices.flat[0].platform == "tpu"
+    except Exception:
+        on_tpu = False
+    if on_tpu and r > 0 and k_local > 0 and _pick_tile(s_local, k_local):
+        return "pallas"
+    return "einsum"
+
+
+def _local_apply(impl: str):
+    """The shard_map local function: bf16 standard-order matrix for the
+    einsum impl, int8 bit-major matrix for the pallas impls."""
+    if impl == "einsum":
+        return _apply_local
+    from chunky_bits_tpu.ops.pallas_kernels import apply_m2_bitmajor
+
+    interp = impl == "pallas_interpret"
+
+    def fn(m2, shards):
+        return apply_m2_bitmajor(m2, shards, interpret=interp)
+
+    return fn
+
+
+def _device_matrix(impl: str, mat: np.ndarray):
+    """Device matrix in the layout the impl's local function expects."""
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    if impl == "einsum":
+        return _device_bit_matrix(mat.tobytes(), *mat.shape)
+    from chunky_bits_tpu.ops.pallas_kernels import bitmajor_device_matrix
+
+    return bitmajor_device_matrix(mat)
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_apply_fn(mesh, impl: str):
+    """Jitted shard_mapped transform, cached per (mesh, impl) so repeated
+    calls reuse the XLA executable instead of retracing."""
     import jax
     from jax.sharding import PartitionSpec as P
 
-    return jax.jit(_shard_map()(
-        _apply_local,
+    return jax.jit(_smap(
+        _local_apply(impl),
         mesh=mesh,
         in_specs=(P(None, None), P("dp", None, "sp")),
         out_specs=P("dp", None, "sp"),
+        impl=impl,
     ))
 
 
-def sharded_apply(mesh, mat: np.ndarray, shards):
+def sharded_apply(mesh, mat: np.ndarray, shards, *, impl: Optional[str] = None):
     """out[B, R, S] = mat ⊗ shards with B split over 'dp' and S over 'sp'.
 
     Parts are independent and the transform is element-wise over S, so both
     shardings are embarrassingly parallel — XLA inserts only the final
-    all-gather to deliver the replicated-out result.
+    all-gather to deliver the replicated-out result.  ``impl`` overrides
+    the per-chip transform choice (tests force "pallas_interpret").
     """
     import jax.numpy as jnp
 
     mat = np.ascontiguousarray(mat, dtype=np.uint8)
-    m2 = _device_bit_matrix(mat.tobytes(), *mat.shape)
-    return _sharded_apply_fn(mesh)(m2, jnp.asarray(shards))
+    r, k = mat.shape
+    s = shards.shape[2]
+    if impl is None:
+        impl = _auto_impl(mesh, r, k, s // mesh.shape["sp"])
+    _check_impl(impl)
+    m2 = _device_matrix(impl, mat)
+    return _sharded_apply_fn(mesh, impl)(m2, jnp.asarray(shards))
 
 
-@functools.lru_cache(maxsize=16)
-def _encode_step_fn(mesh):
+@functools.lru_cache(maxsize=32)
+def _encode_step_fn(mesh, impl: str):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    local = _local_apply(impl)
+
     def step(m2, shards):
-        parity = _apply_local(m2, shards)
+        parity = local(m2, shards)
         local_sum = parity.astype(jnp.uint32).sum()
         checksum = jax.lax.psum(jax.lax.psum(local_sum, "dp"), "sp")
         return parity, checksum
 
-    return jax.jit(_shard_map()(
+    return jax.jit(_smap(
         step,
         mesh=mesh,
         in_specs=(P(None, None), P("dp", None, "sp")),
         out_specs=(P("dp", None, "sp"), P()),
+        impl=impl,
     ))
 
 
-def encode_step_sharded(mesh, encode_matrix: np.ndarray, data):
+def encode_step_sharded(mesh, encode_matrix: np.ndarray, data,
+                        *, impl: Optional[str] = None):
     """One full sharded ingest compute step: parity for every part plus a
     psum'd global checksum (the cross-chip collective exercised over ICI).
 
@@ -167,43 +257,88 @@ def encode_step_sharded(mesh, encode_matrix: np.ndarray, data):
 
     d = encode_matrix.shape[1]
     parity_rows = np.ascontiguousarray(encode_matrix[d:], dtype=np.uint8)
-    m2 = _device_bit_matrix(parity_rows.tobytes(), *parity_rows.shape)
-    return _encode_step_fn(mesh)(m2, jnp.asarray(data))
+    if impl is None:
+        impl = _auto_impl(mesh, parity_rows.shape[0], d,
+                          data.shape[2] // mesh.shape["sp"])
+    _check_impl(impl)
+    m2 = _device_matrix(impl, parity_rows)
+    return _encode_step_fn(mesh, impl)(m2, jnp.asarray(data))
 
 
 # ---------------------------------------------------------------------------
 # Wide-stripe (contraction-sharded) path — BASELINE.md config 5.
 # ---------------------------------------------------------------------------
 
-@functools.lru_cache(maxsize=16)
-def _wide_apply_fn(mesh):
+@functools.lru_cache(maxsize=32)
+def _wide_apply_fn(mesh, impl: str):
     """Jitted transform with the GF contraction split over 'tp'.
 
     Each chip holds a [B/dp, K/tp, S] slice of the input shards and the
-    matching [R8, K8/tp] column block of the bit-matrix; it computes the
-    partial popcount accumulation, which is integer-``psum``'d over 'tp'
+    matching column block of the bit-matrix; it computes the partial
+    popcount accumulation, which is integer-``psum``'d over 'tp'
     (popcounts add across chips because GF(2^8) addition is XOR) and packed
     to bytes with one final mod-2.  Output is replicated within each 'tp'
     group — every chip in the group ends up with the full parity for its
     'dp' slice of parts, ready for the host gather.
+
+    The einsum impl column-shards one standard-order bf16 bit-matrix with
+    ``P(None, 'tp')``.  The pallas impls run the fused accumulation kernel
+    (``acc_m2_bitmajor``) per chip; bit-major column order interleaves
+    byte columns, so the host pre-splits the GF matrix into per-chip byte
+    column blocks, expands each to bit-major, and ships them stacked
+    [tp, R8, K8/tp] sharded ``P('tp', None, None)``.
     """
     import jax
     from jax.sharding import PartitionSpec as P
 
-    def step(m2_cols, shards_local):
-        acc = _acc_local(m2_cols, shards_local)
-        acc = jax.lax.psum(acc, "tp")
-        return _pack_acc(acc)
+    if impl == "einsum":
+        def step(m2_cols, shards_local):
+            acc = _acc_local(m2_cols, shards_local)
+            acc = jax.lax.psum(acc, "tp")
+            return _pack_acc(acc)
 
-    return jax.jit(_shard_map()(
+        m2_spec = P(None, "tp")
+    else:
+        from chunky_bits_tpu.ops.pallas_kernels import (acc_m2_bitmajor,
+                                                        pack_acc_bitmajor)
+
+        interp = impl == "pallas_interpret"
+
+        def step(m2_blocks, shards_local):
+            acc = acc_m2_bitmajor(m2_blocks[0], shards_local,
+                                  interpret=interp)
+            acc = jax.lax.psum(acc, "tp")
+            return pack_acc_bitmajor(acc)
+
+        m2_spec = P("tp", None, None)
+
+    return jax.jit(_smap(
         step,
         mesh=mesh,
-        in_specs=(P(None, "tp"), P("dp", "tp", None)),
+        in_specs=(m2_spec, P("dp", "tp", None)),
         out_specs=P("dp", None, None),
+        impl=impl,
     ))
 
 
-def wide_apply_sharded(mesh, mat: np.ndarray, shards):
+@functools.lru_cache(maxsize=16)
+def _host_bitmajor_blocks(mat_bytes: bytes, r: int, k: int,
+                          tp: int) -> np.ndarray:
+    """Per-chip bit-major column blocks [tp, R8, (K/tp)*8] for the pallas
+    wide-stripe path."""
+    from chunky_bits_tpu.ops.pallas_kernels import bit_matrix_bitmajor
+
+    mat = np.frombuffer(mat_bytes, dtype=np.uint8).reshape(r, k)
+    kb = k // tp
+    blocks = [
+        bit_matrix_bitmajor(np.ascontiguousarray(mat[:, t * kb:(t + 1) * kb]))
+        for t in range(tp)
+    ]
+    return np.stack(blocks).astype(np.int8)
+
+
+def wide_apply_sharded(mesh, mat: np.ndarray, shards,
+                       *, impl: Optional[str] = None):
     """out[B, R, S] = mat ⊗ shards with B over 'dp' and the K (stripe)
     axis over 'tp'.  ``mat`` is a GF(2^8) matrix [R, K] (parity rows for
     encode, host-inverted rows for decode — the same primitive serves
@@ -217,13 +352,21 @@ def wide_apply_sharded(mesh, mat: np.ndarray, shards):
     if k % tp != 0:
         raise ValueError(f"stripe width {k} not divisible by tp={tp}")
     mat = np.ascontiguousarray(mat, dtype=np.uint8)
-    m2 = _device_bit_matrix(mat.tobytes(), r, k)
-    return _wide_apply_fn(mesh)(m2, jnp.asarray(shards))
+    if impl is None:
+        impl = _auto_impl(mesh, r, k // tp, shards.shape[2])
+    _check_impl(impl)
+    if impl == "einsum":
+        m2 = _device_bit_matrix(mat.tobytes(), r, k)
+    else:
+        m2 = jnp.asarray(_host_bitmajor_blocks(mat.tobytes(), r, k, tp),
+                         dtype=jnp.int8)
+    return _wide_apply_fn(mesh, impl)(m2, jnp.asarray(shards))
 
 
-def encode_wide_sharded(mesh, encode_matrix: np.ndarray, data):
+def encode_wide_sharded(mesh, encode_matrix: np.ndarray, data,
+                        *, impl: Optional[str] = None):
     """Wide-stripe parity: data uint8 [B, d, S] with d split over 'tp'
     (and B over 'dp') -> parity uint8 [B, p, S]."""
     d = encode_matrix.shape[1]
     parity_rows = np.ascontiguousarray(encode_matrix[d:], dtype=np.uint8)
-    return wide_apply_sharded(mesh, parity_rows, data)
+    return wide_apply_sharded(mesh, parity_rows, data, impl=impl)
