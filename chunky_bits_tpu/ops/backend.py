@@ -151,6 +151,25 @@ def _hash_rows_hashlib(rows: np.ndarray, out: np.ndarray) -> None:
 
 
 _ROW_HASHER = None
+_INGEST_POOL = None
+_INGEST_POOL_LOCK = threading.Lock()
+
+
+def _ingest_hash_pool():
+    """Small shared thread pool for overlapping host-side SHA-256 with
+    asynchronous device dispatch (jax/mesh backends).  Two workers: one
+    for the data rows, one draining parity blocks as they land; the
+    native hashing engine releases the GIL, so both run truly parallel
+    to the dispatching thread."""
+    global _INGEST_POOL
+    if _INGEST_POOL is None:
+        with _INGEST_POOL_LOCK:
+            if _INGEST_POOL is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                _INGEST_POOL = ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix="cb-ingest-hash")
+    return _INGEST_POOL
 
 
 def _row_hasher():
@@ -227,11 +246,19 @@ class ErasureCoder:
         fused = getattr(self.backend, "encode_and_hash", None)
         if fused is not None:
             return fused(self.parity_rows, np.ascontiguousarray(data))
-        parity = self.encode_batch(data)
+        data = np.ascontiguousarray(data)
         b, _, _ = data.shape
         hash_rows = _row_hasher()
         data_digests = np.empty((b, self.data, 32), dtype=np.uint8)
-        hash_rows(np.ascontiguousarray(data), data_digests)
+        if getattr(self.backend, "async_dispatch", False):
+            # device backends (mesh): hash the data rows on the host
+            # while the device computes parity
+            fut = _ingest_hash_pool().submit(hash_rows, data, data_digests)
+            parity = self.encode_batch(data)
+            fut.result()
+        else:
+            parity = self.encode_batch(data)
+            hash_rows(data, data_digests)
         if not self.parity:
             return parity, data_digests
         parity_digests = np.empty((b, self.parity, 32), dtype=np.uint8)

@@ -103,7 +103,8 @@ class JaxBackend(ErasureBackend):
                 self._m2_cache.popitem(last=False)
         return dev
 
-    def apply_matrix(self, mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    def apply_matrix(self, mat: np.ndarray, shards: np.ndarray,
+                     on_block=None) -> np.ndarray:
         jax, jnp = _ensure_jax()
         b, k, s = shards.shape
         r = mat.shape[0]
@@ -111,7 +112,7 @@ class JaxBackend(ErasureBackend):
             return np.zeros((b, r, s), dtype=np.uint8)
         if self._on_tpu and s % 128 == 0 and s >= 1024:
             try:
-                return self._apply_pallas_blocked(mat, shards)
+                return self._apply_pallas_blocked(mat, shards, on_block)
             except Exception as err:
                 # An unexpected Mosaic/compile failure would otherwise be
                 # re-attempted (and re-compiled, seconds each) on every
@@ -121,6 +122,11 @@ class JaxBackend(ErasureBackend):
                 warnings.warn(
                     f"pallas erasure kernel disabled after failure: {err}")
                 self._on_tpu = False
+                # blocks already delivered through on_block keep their
+                # (valid) results; suppress the callback for the einsum
+                # retry so those rows aren't re-fired concurrently —
+                # encode_and_hash reconciles never-seen rows afterwards
+                on_block = None
         m2 = self._bit_matrix(mat)
         fn = _jitted_apply()
         # Block the batch axis so the 16x bit expansion fits device memory
@@ -128,39 +134,103 @@ class JaxBackend(ErasureBackend):
         per_item = k * s * 16
         block = max(1, self.max_block_bytes // 2 // max(per_item, 1))
         return self._pipelined_blocks(lambda dev: fn(m2, dev),
-                                      shards, block)
+                                      shards, block, on_block)
 
     def _pipelined_blocks(self, dispatch, shards: np.ndarray,
-                          block: int) -> np.ndarray:
+                          block: int, on_block=None) -> np.ndarray:
         """Run ``dispatch`` over batch blocks with H2D/compute overlap:
         jax dispatch is asynchronous, so issuing block N+1's device_put
         and kernel before materializing block N's result lets the next
         host->device transfer (and compute) proceed while the host blocks
         on the previous device->host copy.  Two blocks in flight — the
-        classic double buffer."""
+        classic double buffer.  ``on_block(lo, arr)`` fires on the main
+        thread as each output block materializes, so callers can overlap
+        host post-processing (shard hashing) with the remaining device
+        work."""
         jax, _ = _ensure_jax()
         b = shards.shape[0]
         if b <= block:
-            return np.asarray(dispatch(jax.device_put(shards)))
+            out = np.asarray(dispatch(jax.device_put(shards)))
+            if on_block is not None:
+                on_block(0, out)
+            return out
         outs = []
         pending = []
         for lo in range(0, b, block):
             dev = jax.device_put(np.ascontiguousarray(shards[lo:lo + block]))
             pending.append(dispatch(dev))
             if len(pending) > 1:
-                outs.append(np.asarray(pending.pop(0)))
-        outs.extend(np.asarray(o) for o in pending)
+                arr = np.asarray(pending.pop(0))
+                if on_block is not None:
+                    on_block(len(outs) * block, arr)
+                outs.append(arr)
+        for o in pending:
+            arr = np.asarray(o)
+            if on_block is not None:
+                on_block(len(outs) * block, arr)
+            outs.append(arr)
         return np.concatenate(outs, axis=0)
 
     #: the fused kernel keeps bits in VMEM, so its device footprint is just
     #: data + parity; a much larger per-dispatch budget applies.
     max_pallas_block_bytes = 2 << 30
 
-    def _apply_pallas_blocked(self, mat: np.ndarray, shards) -> np.ndarray:
+    def _apply_pallas_blocked(self, mat: np.ndarray, shards,
+                              on_block=None) -> np.ndarray:
         from chunky_bits_tpu.ops.pallas_kernels import apply_matrix_pallas
 
         b, k, s = shards.shape
         per_item = k * s * 2
         block = max(1, self.max_pallas_block_bytes // 2 // max(per_item, 1))
         return self._pipelined_blocks(
-            lambda dev: apply_matrix_pallas(mat, dev), shards, block)
+            lambda dev: apply_matrix_pallas(mat, dev), shards, block,
+            on_block)
+
+    def encode_and_hash(
+        self, mat: np.ndarray, shards: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Overlapped ingest: the device computes parity while the host
+        hashes the data rows, and each parity block is hashed as it lands
+        while later blocks are still in flight.  The generic fallback
+        (ops/backend.py) runs encode-then-hash strictly serially, leaving
+        the host idle during device compute — the reference's CPU path is
+        serial too (src/file/file_part.rs:161,185).  Output is identical
+        to the fused native engine's, bit for bit."""
+        from chunky_bits_tpu.ops.backend import _ingest_hash_pool, \
+            _row_hasher
+
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        b, k, s = shards.shape
+        r = mat.shape[0]
+        hash_rows = _row_hasher()
+        data_digests = np.empty((b, k, 32), dtype=np.uint8)
+        parity_digests = np.empty((b, r, 32), dtype=np.uint8)
+        if b == 0 or s == 0 or r == 0:
+            parity = np.zeros((b, r, s), dtype=np.uint8)
+            hash_rows(shards, data_digests)
+            hash_rows(parity, parity_digests)
+            return parity, np.concatenate(
+                [data_digests, parity_digests], axis=1)
+        pool = _ingest_hash_pool()
+        futs = [pool.submit(hash_rows, shards, data_digests)]
+        covered = np.zeros(b, dtype=bool)
+
+        def on_block(lo, arr):
+            # axis-0 slices of the C-contiguous digest array are
+            # contiguous, so the hasher can write in place
+            covered[lo:lo + arr.shape[0]] = True
+            futs.append(pool.submit(
+                hash_rows, arr, parity_digests[lo:lo + arr.shape[0]]))
+
+        parity = self.apply_matrix(mat, shards, on_block=on_block)
+        for f in futs:
+            f.result()
+        if not covered.all():
+            # a mid-run pallas->einsum fallback suppresses the callback
+            # for its retry; hash the rows no callback ever delivered
+            idx = np.flatnonzero(~covered)
+            rest = np.empty((len(idx), r, 32), dtype=np.uint8)
+            hash_rows(np.ascontiguousarray(parity[idx]), rest)
+            parity_digests[idx] = rest
+        return parity, np.concatenate([data_digests, parity_digests],
+                                      axis=1)

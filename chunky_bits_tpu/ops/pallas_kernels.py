@@ -70,8 +70,16 @@ def _host_matrix(mat_bytes: bytes, r: int, k: int) -> np.ndarray:
     return bit_matrix_bitmajor(mat).astype(np.int8)
 
 
-@functools.lru_cache(maxsize=32)
-def _build_kernel(r: int, k: int, tile_s: int, bblock: int, interpret: bool):
+@functools.lru_cache(maxsize=64)
+def _build_kernel(r: int, k: int, tile_s: int, bblock: int, interpret: bool,
+                  pack: bool = True):
+    """``pack=True`` emits packed parity bytes [B, R, S] (the fused
+    single-chip transform).  ``pack=False`` stops before the mod-2/pack
+    and emits the raw int32 popcount accumulator [B, R8, S] — the
+    per-chip half of the contraction-sharded (tp) mesh path: partial
+    popcounts from different chips *add* (GF(2^8) addition is XOR), so
+    the mesh layer can ``psum`` them over ICI and apply one mod-2/pack
+    after the collective (parallel/mesh.py)."""
     jax = _jx()
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -93,60 +101,16 @@ def _build_kernel(r: int, k: int, tile_s: int, bblock: int, interpret: bool):
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.int32,
             )  # [R8, TS]
+            if not pack:
+                out_ref[bi] = acc
+                continue
             acc = acc & 1
             packed = acc[0:r, :]
             for b in range(1, 8):
                 packed = packed | (acc[b * r:(b + 1) * r, :] << b)
             out_ref[bi] = packed.astype(jnp.uint8)
 
-    def call(m2, data):
-        batch, _k, s = data.shape
-        grid = (batch // bblock, s // tile_s)
-        return pl.pallas_call(
-            kernel,
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((r8, k8), lambda b, j: (0, 0)),
-                pl.BlockSpec((bblock, k, tile_s), lambda b, j: (b, 0, j)),
-            ],
-            out_specs=pl.BlockSpec((bblock, r, tile_s),
-                                   lambda b, j: (b, 0, j)),
-            out_shape=jax.ShapeDtypeStruct((batch, r, s), jnp.uint8),
-            scratch_shapes=[pltpu.VMEM((k8, tile_s), jnp.int8)],
-            interpret=interpret,
-        )(m2, data)
-
-    return jax.jit(call)
-
-
-@functools.lru_cache(maxsize=32)
-def _build_acc_kernel(r: int, k: int, tile_s: int, bblock: int,
-                      interpret: bool):
-    """Like ``_build_kernel`` but stops before the mod-2/pack: emits the
-    raw int32 popcount accumulator [B, R8, S].  This is the per-chip half
-    of the contraction-sharded (tp) mesh path — partial popcounts from
-    different chips *add* (GF(2^8) addition is XOR), so the mesh layer can
-    ``psum`` these over ICI and apply one mod-2/pack after the collective
-    (parallel/mesh.py)."""
-    jax = _jx()
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    r8, k8 = r * 8, k * 8
-
-    def kernel(m2_ref, data_ref, out_ref, bits_ref):
-        for bi in range(bblock):
-            data = data_ref[bi].astype(jnp.int32)  # [K, TS]
-            for b in range(8):
-                bits_ref[b * k:(b + 1) * k, :] = (
-                    (data >> b) & 1
-                ).astype(jnp.int8)
-            out_ref[bi] = jax.lax.dot_general(
-                m2_ref[...], bits_ref[...],
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.int32,
-            )  # [R8, TS]
+    out_rows, out_dtype = (r, jnp.uint8) if pack else (r8, jnp.int32)
 
     def call(m2, data):
         batch, _k, s = data.shape
@@ -158,9 +122,9 @@ def _build_acc_kernel(r: int, k: int, tile_s: int, bblock: int,
                 pl.BlockSpec((r8, k8), lambda b, j: (0, 0)),
                 pl.BlockSpec((bblock, k, tile_s), lambda b, j: (b, 0, j)),
             ],
-            out_specs=pl.BlockSpec((bblock, r8, tile_s),
+            out_specs=pl.BlockSpec((bblock, out_rows, tile_s),
                                    lambda b, j: (b, 0, j)),
-            out_shape=jax.ShapeDtypeStruct((batch, r8, s), jnp.int32),
+            out_shape=jax.ShapeDtypeStruct((batch, out_rows, s), out_dtype),
             scratch_shapes=[pltpu.VMEM((k8, tile_s), jnp.int8)],
             interpret=interpret,
         )(m2, data)
@@ -217,7 +181,7 @@ def acc_m2_bitmajor(m2, shards, *, interpret: bool = False):
     tile = _pick_tile(s, k, row_bytes=r8 * 4 * bblock)
     if tile == 0 or r == 0:
         raise ValueError(f"shard size {s} not tileable for pallas path")
-    fn = _build_acc_kernel(r, k, tile, bblock, interpret)
+    fn = _build_kernel(r, k, tile, bblock, interpret, pack=False)
     return fn(m2, shards)
 
 

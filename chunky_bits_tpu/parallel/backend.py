@@ -54,6 +54,10 @@ def parse_mesh_spec(spec: str) -> dict[str, int]:
 class MeshJaxBackend(ErasureBackend):
     """GF(2^8) matrix application sharded over a device mesh."""
 
+    #: the generic ingest path overlaps host hashing with the sharded
+    #: device dispatch (ops/backend.py encode_hash_batch)
+    async_dispatch = True
+
     def __init__(self, spec: str):
         from chunky_bits_tpu.parallel import mesh as mesh_mod
 
