@@ -212,6 +212,7 @@ def bench_cp_pipeline(argv: list) -> None:
     gib = flag("--gib", 1.0, float)
     backend = flag("--backend", "jax", str)
     batch = flag("--batch", 256, int)
+    stage = flag("--stage", 8, int)
     no_hash = "--no-hash" in argv
 
     d, p, chunk = 10, 4, 1 << 20
@@ -241,21 +242,31 @@ def bench_cp_pipeline(argv: list) -> None:
 
     class NoHashBatcher(EncodeHashBatcher):
         """Parity on the device, zero digests: isolates the pipeline
-        from the 1-core host SHA bound.  Mirrors the parent's
-        concat-into-one-dispatch shape so dispatch counts (and the
-        structure being measured) stay comparable to the hash-on run."""
+        from the 1-core host SHA bound.  Mirrors the parent's merge
+        policy (merge only for merge-preferring device backends) so the
+        pipeline structure and dispatch counts stay comparable to the
+        hash-on run."""
 
         def _run_group(self, key, batches):
             from chunky_bits_tpu.ops.backend import get_coder
 
             dd, pp, _size = key
-            self.dispatches += 1
             coder = get_coder(dd, pp, self.backend)
-            merged = batches[0] if len(batches) == 1 \
-                else np.concatenate(batches, axis=0)
+
+            def zero_digests(stacked):
+                return np.zeros((stacked.shape[0], dd + pp, 32),
+                                dtype=np.uint8)
+
+            merge = getattr(coder.backend, "prefers_merged_batches",
+                            False)
+            if not merge or len(batches) == 1:
+                self.dispatches += len(batches)
+                return [(coder.encode_batch(b), zero_digests(b))
+                        for b in batches]
+            self.dispatches += 1
+            merged = np.concatenate(batches, axis=0)
             parity = coder.encode_batch(merged)
-            digests = np.zeros((merged.shape[0], dd + pp, 32),
-                               dtype=np.uint8)
+            digests = zero_digests(merged)
             out = []
             lo = 0
             for stacked in batches:
@@ -278,6 +289,7 @@ def bench_cp_pipeline(argv: list) -> None:
                    .with_data_chunks(d).with_parity_chunks(p)
                    .with_concurrency(batch + 4)
                    .with_batch_parts(batch)
+                   .with_stage_parts(stage)
                    .with_backend(backend)
                    .with_encode_batcher(make_batcher))
         # warm (compile, thread pools) with one small batch
@@ -388,8 +400,12 @@ def bench_small_objects() -> None:
         t0 = time.perf_counter()
         await asyncio.gather(*[one(o) for o in objs[1:]])
         dt = time.perf_counter() - t0
-        coalesce = (n_objects - 1) / max(batcher.dispatches - 1, 1)
-        print(f"# coalescing factor: {coalesce:.1f} objects/dispatch; "
+        # grouping factor: requests per coalesced group (merge-preferring
+        # device backends additionally turn each group into ONE dispatch;
+        # CPU backends run the group's batches back-to-back unmerged)
+        coalesce = (n_objects - 1) / max(batcher.groups - 1, 1)
+        print(f"# coalescing factor: {coalesce:.1f} objects/group "
+              f"({batcher.dispatches} codec dispatches); "
               f"host cores: {os.cpu_count()} (per-shard SHA-256 is "
               f"host-side and scales with cores)", file=sys.stderr)
         return (n_objects - 1) * obj_bytes / dt / (1 << 30)

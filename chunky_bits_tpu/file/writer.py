@@ -8,10 +8,21 @@ fail fast on the first error.  Defaults match writer.rs:50-59
 
 TPU twist: the reference encodes one part per call
 (src/file/writer.rs:208-218 -> file_part.rs:161); a TPU wants batches.
-``batch_parts > 1`` stages up to that many parts and encodes them in a
-single device dispatch (grouped by shard length, so the full-size stripes
-share one [B, d, S] dispatch), without changing ordered metadata assembly
-or the fail-fast error path.
+``batch_parts > 1`` stages parts and encodes them in batched device
+dispatches (grouped by shard length, so the full-size stripes share one
+[B, d, S] dispatch), without changing ordered metadata assembly or the
+fail-fast error path.
+
+Staging streams: parts are handed to encode in sub-blocks of
+``stage_parts`` (default 8) as they fill, so the read loop, the staging
+copy, the device encode, and the destination writes all overlap — a large
+``batch_parts`` raises the *dispatch* coalescing bound (an
+EncodeHashBatcher — the caller's shared one, or one the writer creates
+for merge-preferring device backends — merges concurrent sub-blocks into
+one [ΣB, d, S] dispatch), not the amount of data serialized behind a
+single staging copy.  Round-2 measurement of the unstreamed design:
+batch=256 collapsed to 0.09 GiB/s because 2.5 GiB sat in buffers while
+nothing encoded or wrote.
 """
 
 from __future__ import annotations
@@ -37,6 +48,12 @@ class FileWriteBuilder:
     parity: int = 2
     concurrency: int = 10
     batch_parts: int = 1
+    #: staging granularity: parts are flushed to encode in sub-blocks of
+    #: this size, so staging never serializes more than this many parts
+    #: behind one copy (batch_parts stays the dispatch coalescing bound).
+    #: Swept on the 1-core bench host: 4-16 all sustain ~0.38 GiB/s
+    #: through config 2 at any batch; 32+ collapses to ~0.1.
+    stage_parts: int = 8
     backend: Optional[str] = None
     content_type: Optional[str] = None
     #: an ops.batching.EncodeHashBatcher shared across concurrent writes
@@ -65,6 +82,9 @@ class FileWriteBuilder:
     def with_batch_parts(self, batch_parts: int) -> "FileWriteBuilder":
         return replace(self, batch_parts=batch_parts)
 
+    def with_stage_parts(self, stage_parts: int) -> "FileWriteBuilder":
+        return replace(self, stage_parts=stage_parts)
+
     def with_backend(self, backend: Optional[str]) -> "FileWriteBuilder":
         return replace(self, backend=backend)
 
@@ -79,6 +99,7 @@ class FileWriteBuilder:
         if self.concurrency <= 1:
             raise FileWriteError("concurrency must be > 1")
         batch_parts = max(1, min(self.batch_parts, self.concurrency))
+        stage_size = max(1, min(batch_parts, self.stage_parts))
         d, p = self.data, self.parity
         coder = get_coder(d, p, self.backend)
         from chunky_bits_tpu.file.collection_destination import \
@@ -87,12 +108,36 @@ class FileWriteBuilder:
         destination = as_destination(self.destination)
 
         sem = asyncio.Semaphore(self.concurrency)
-        staged: list[tuple[bytes, int]] = []  # (buffer, meaningful length)
-        total_bytes = 0
 
         encode_batcher = self.encode_batcher
         if callable(encode_batcher):
             encode_batcher = encode_batcher()
+        merging = getattr(coder.backend, "prefers_merged_batches", False)
+        own_batcher = False
+        if encode_batcher is None and merging and batch_parts > stage_size:
+            # device backend with no shared batcher: coalesce this
+            # write's own sub-blocks back into [<=batch_parts, d, S]
+            # dispatches, so streamed staging doesn't shrink the device
+            # batches that amortize per-dispatch overhead
+            from chunky_bits_tpu.ops.batching import EncodeHashBatcher
+
+            encode_batcher = EncodeHashBatcher(backend=self.backend,
+                                               max_batch=batch_parts)
+            own_batcher = True
+
+        # Read-ahead bound: by default at most two sub-blocks of raw parts
+        # may sit staged-or-encoding at once (classic double buffer: one
+        # encoding, one filling).  Without it a large concurrency lets the
+        # read loop race GiBs of buffers ahead of the encoder, thrashing
+        # caches and starving the pipeline it is supposed to feed
+        # (measured round 4: batch=256 at 0.09 GiB/s, recovering to a
+        # flat 0.38 with the bound).  Merge-preferring device backends
+        # get a window of batch_parts instead — pending sub-blocks are
+        # what the batcher merges into full-size dispatches.
+        encode_ahead = asyncio.Semaphore(
+            max(2 * stage_size, batch_parts if merging else 0))
+        staged: list[tuple[bytes, int]] = []  # (buffer, meaningful length)
+        total_bytes = 0
 
         def stage(items: list[tuple[bytes, int]]):
             """Group staged parts by shard length and copy each part
@@ -171,7 +216,12 @@ class FileWriteBuilder:
             except BaseException:
                 for _ in items:
                     sem.release()
+                    encode_ahead.release()
                 raise
+            # raw buffers are consumed; let the read loop stage the next
+            # sub-block while these parts flow to the destination
+            for _ in items:
+                encode_ahead.release()
             return await aio.gather_or_cancel(
                 [write_part(x) for x in pre])
 
@@ -211,15 +261,17 @@ class FileWriteBuilder:
         try:
             while True:
                 await sem.acquire()
+                await encode_ahead.acquire()
                 buf = await aio.read_exact_or_eof(
                     reader, d * self.chunk_size)
                 if not buf:
                     sem.release()
+                    encode_ahead.release()
                     break
                 total_bytes += len(buf)
                 staged.append((buf, len(buf)))
                 short_read = len(buf) < d * self.chunk_size
-                if len(staged) >= batch_parts or short_read:
+                if len(staged) >= stage_size or short_read:
                     # the just-staged parts keep their permits until their
                     # write tasks complete
                     flush()
@@ -237,6 +289,12 @@ class FileWriteBuilder:
             # (reference behavior, main.rs:329-435).
             await cancel_all()
             raise
+        finally:
+            if own_batcher:
+                # writer-owned batcher: drain its in-flight dispatches so
+                # no task outlives the write (shared batchers belong to
+                # the caller's scope)
+                await encode_batcher.aclose()
         return FileReference(
             content_type=self.content_type,
             compression=None,

@@ -71,6 +71,10 @@ class JaxBackend(ErasureBackend):
 
     name = "jax"
 
+    #: batchers should merge concurrent requests into one dispatch —
+    #: per-dispatch overhead dwarfs the host-side concatenate copy
+    prefers_merged_batches = True
+
     #: cap device memory per dispatch: bits blow bytes up 8x as bf16 (16x B)
     max_block_bytes = 64 << 20
 

@@ -58,6 +58,9 @@ class MeshJaxBackend(ErasureBackend):
     #: device dispatch (ops/backend.py encode_hash_batch)
     async_dispatch = True
 
+    #: merged batcher dispatches amortize per-dispatch mesh RPC overhead
+    prefers_merged_batches = True
+
     def __init__(self, spec: str):
         from chunky_bits_tpu.parallel import mesh as mesh_mod
 

@@ -171,8 +171,11 @@ def test_degraded_multi_part_read_batches(tmp_path, monkeypatch):
 
 
 def test_encode_hash_batcher_identity_and_coalescing():
-    """Concurrent small-object encodes coalesce into shared dispatches and
-    return parity + digests identical to the unbatched coder."""
+    """Concurrent small-object encodes return parity + digests identical
+    to the unbatched coder; merge-preferring (device) backends coalesce
+    pending requests into shared dispatches, CPU backends run them
+    unmerged (the concatenate copy costs more than it saves there)."""
+    from chunky_bits_tpu.ops.backend import register_backend
     from chunky_bits_tpu.ops.batching import EncodeHashBatcher
 
     d, p, size = 4, 2, 1024
@@ -181,17 +184,38 @@ def test_encode_hash_batcher_identity_and_coalescing():
                for _ in range(12)]
     coder = ErasureCoder(d, p, NumpyBackend())
 
-    async def main():
-        batcher = EncodeHashBatcher(backend="numpy")
+    class MergingNumpy(NumpyBackend):
+        """Stands in for a device backend in the merge path."""
+
+        name = "numpy-merging"
+        prefers_merged_batches = True
+
+    async def run(backend):
+        batcher = EncodeHashBatcher(backend=backend)
         results = await asyncio.gather(
             *[batcher.encode_hash(d, p, b) for b in batches])
         for stacked, (parity, digests) in zip(batches, results):
             want_par, want_dig = coder.encode_hash_batch(stacked)
             assert np.array_equal(parity, want_par)
             assert np.array_equal(digests, want_dig)
-        assert batcher.dispatches < len(batches)
+        return batcher
 
-    asyncio.run(main())
+    async def main():
+        # the merge path: concurrent requests share dispatches
+        assert (await run("numpy-merging")).dispatches < len(batches)
+        # the unmerged CPU path: one codec dispatch per request, same
+        # results, but requests still coalesce into shared groups
+        b = await run("numpy")
+        assert b.dispatches == len(batches)
+        assert b.groups < len(batches)
+
+    from chunky_bits_tpu.ops import backend as backend_mod
+
+    register_backend(MergingNumpy())
+    try:
+        asyncio.run(main())
+    finally:
+        backend_mod._REGISTRY.pop("numpy-merging", None)
 
 
 def test_encode_hash_batcher_mixed_geometries():
