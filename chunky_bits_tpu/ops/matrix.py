@@ -9,6 +9,13 @@ byte-identical with the reference (reference: src/file/file_part.rs:77 —
     E = V @ inv(V[:d])             (systematic: E[:d] == I)
 
 Parity rows are ``E[d:]``; reconstruction inverts the d surviving rows.
+
+Externally anchored (tests/test_matrix_conformance.py): the published
+Backblaze 4+2 coding matrix, the QR-standard (ISO/IEC 18004) antilog
+table for 0x11D/generator-2, and a from-scratch independent
+implementation sharing no code with this module, equality-checked over a
+(d, p) grid — a convention bug here is detectable without trusting this
+derivation.
 """
 
 from __future__ import annotations

@@ -162,6 +162,62 @@ def test_streamed_staging_roundtrip(tmp_path):
     asyncio.run(main())
 
 
+def test_writer_owns_batcher_for_merging_backend(tmp_path):
+    """A merge-preferring (device) backend with no shared batcher gets a
+    writer-owned EncodeHashBatcher, so streamed sub-blocks coalesce back
+    into large dispatches instead of issuing one device RPC per
+    sub-block."""
+    from chunky_bits_tpu.ops import batching
+    from chunky_bits_tpu.ops.backend import NumpyBackend, register_backend
+    from chunky_bits_tpu.ops import backend as backend_mod
+
+    class MergingNumpy(NumpyBackend):
+        name = "numpy-merging"
+        prefers_merged_batches = True
+
+    created = []
+    orig_init = batching.EncodeHashBatcher.__init__
+
+    def spy_init(self, *a, **kw):
+        orig_init(self, *a, **kw)
+        created.append(self)
+
+    d, p, chunk = 3, 2, 1024
+    payload = synthetic_bytes(d * chunk * 20, seed=43)
+    dirs = []
+    for i in range(5):
+        dd = tmp_path / f"disk{i}"
+        dd.mkdir()
+        dirs.append(Location.parse(str(dd)))
+
+    async def main():
+        builder = (FileWriteBuilder()
+                   .with_destination(LocationsDestination(dirs))
+                   .with_chunk_size(chunk)
+                   .with_data_chunks(d)
+                   .with_parity_chunks(p)
+                   .with_batch_parts(64)
+                   .with_stage_parts(4)
+                   .with_concurrency(68)
+                   .with_backend("numpy-merging"))
+        ref = await builder.write(aio.BytesReader(payload))
+        assert len(created) == 1, "writer should own exactly one batcher"
+        assert created[0].max_batch == 64
+        # sub-blocks of 4 coalesced: far fewer dispatches than the 20
+        # parts, and the content still reads back exactly
+        assert created[0].dispatches < 20
+        got = await FileReadBuilder(ref).read_all()
+        assert got == payload
+
+    register_backend(MergingNumpy())
+    batching.EncodeHashBatcher.__init__ = spy_init
+    try:
+        asyncio.run(main())
+    finally:
+        batching.EncodeHashBatcher.__init__ = orig_init
+        backend_mod._REGISTRY.pop("numpy-merging", None)
+
+
 def test_read_survives_chunk_loss(tmp_path):
     payload = synthetic_bytes(200000, seed=5)
     dirs = []

@@ -1,0 +1,219 @@
+"""External anchors for the Reed-Solomon matrix convention.
+
+``ops/matrix.py`` claims byte-compatibility with the reference's
+``reed-solomon-erasure`` crate (the Backblaze JavaReedSolomon
+construction; reference src/file/file_part.rs:77, Cargo.toml:21).  Until
+this module, every test of that claim was derived from ops/matrix.py
+itself — a subtly wrong convention would have passed the whole suite.
+Two independent anchors break the circularity:
+
+1. **Published vectors.** The Backblaze "Erasure Coding" blog post and
+   JavaReedSolomon README print the full 6x4 coding matrix for 4 data +
+   2 parity shards; the QR-code standard (ISO/IEC 18004) publishes the
+   GF(2^8) antilog table for polynomial 0x11D with generator 2 — the
+   exact field the crate uses.  Both are transcribed here as literals.
+
+2. **An independent implementation.** A from-scratch pure-Python
+   construction of the same published recipe (Vandermonde V[r,c] = r^c,
+   top-square inversion, systematic product) sharing *no* code with
+   ops/matrix.py or ops/gf256.py: carry-less "Russian peasant"
+   multiplication instead of log/exp tables, Fermat inversion (a^254)
+   instead of table lookup, its own Gauss-Jordan over lists of ints.
+   Equality is asserted across a (d, p) grid and for decode matrices.
+"""
+
+import numpy as np
+import pytest
+
+from chunky_bits_tpu.ops import matrix
+
+# ---------------------------------------------------------------------------
+# Anchor 1a: the published Backblaze 4+2 coding matrix (blog post
+# "Backblaze Open-sources Reed-Solomon Erasure Coding Source Code",
+# 2015; same matrix appears in the JavaReedSolomon sources).
+# ---------------------------------------------------------------------------
+
+BACKBLAZE_4_2 = [
+    [1, 0, 0, 0],
+    [0, 1, 0, 0],
+    [0, 0, 1, 0],
+    [0, 0, 0, 1],
+    [27, 28, 18, 20],
+    [28, 27, 20, 18],
+]
+
+# ---------------------------------------------------------------------------
+# Anchor 1b: the QR-standard GF(2^8) antilog table prefix — powers of the
+# generator 2 modulo 0x11D (ISO/IEC 18004; widely reprinted).  Pins both
+# the reduction polynomial and the generator: the AES field (0x11B) or a
+# generator-3 field diverges at index 8 and 1 respectively.
+# ---------------------------------------------------------------------------
+
+ANTILOG_0X11D_PREFIX = [1, 2, 4, 8, 16, 32, 64, 128,
+                        29, 58, 116, 232, 205, 135, 19, 38]
+
+
+def test_backblaze_published_matrix():
+    got = matrix.build_encode_matrix(4, 2)
+    assert got.tolist() == BACKBLAZE_4_2
+
+
+def test_published_antilog_prefix():
+    from chunky_bits_tpu.ops import gf256
+
+    assert [gf256.gf_pow(2, i) for i in range(16)] == ANTILOG_0X11D_PREFIX
+    # the generator has full order: 2^255 == 1, and no smaller
+    # power-of-interest collapses (3, 5, 17 divide 255)
+    assert gf256.gf_pow(2, 255) == 1
+    assert all(gf256.gf_pow(2, 255 // f) != 1 for f in (3, 5, 17))
+
+
+# ---------------------------------------------------------------------------
+# Anchor 2: the independent implementation.  Everything below is
+# deliberately self-contained — plain ints and lists, no numpy, no
+# imports from chunky_bits_tpu.ops.
+# ---------------------------------------------------------------------------
+
+
+def _mul(a: int, b: int) -> int:
+    """Carry-less multiply with on-the-fly 0x11D reduction."""
+    prod = 0
+    while b:
+        if b & 1:
+            prod ^= a
+        b >>= 1
+        a <<= 1
+        if a & 0x100:
+            a ^= 0x11D
+    return prod
+
+
+def _pow(base: int, exp: int) -> int:
+    out = 1
+    for _ in range(exp):
+        out = _mul(out, base)
+    return out  # 0^0 == 1, the Backblaze vandermonde convention
+
+
+def _inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("no inverse of 0 in GF(2^8)")
+    return _pow(a, 254)  # Fermat: a^(2^8 - 2)
+
+
+def _mat_mul(a: list, b: list) -> list:
+    rows, inner, cols = len(a), len(b), len(b[0])
+    assert len(a[0]) == inner
+    out = [[0] * cols for _ in range(rows)]
+    for i in range(rows):
+        for j in range(cols):
+            acc = 0
+            for k in range(inner):
+                acc ^= _mul(a[i][k], b[k][j])
+            out[i][j] = acc
+    return out
+
+
+def _mat_inv(m: list) -> list:
+    n = len(m)
+    work = [list(row) + [1 if i == j else 0 for j in range(n)]
+            for i, row in enumerate(m)]
+    for col in range(n):
+        pivot = next((r for r in range(col, n) if work[r][col]), None)
+        if pivot is None:
+            raise ValueError("singular")
+        work[col], work[pivot] = work[pivot], work[col]
+        scale = _inv(work[col][col])
+        work[col] = [_mul(scale, x) for x in work[col]]
+        for r in range(n):
+            if r != col and work[r][col]:
+                f = work[r][col]
+                work[r] = [x ^ _mul(f, y)
+                           for x, y in zip(work[r], work[col])]
+    return [row[n:] for row in work]
+
+
+def _encode_matrix(d: int, p: int) -> list:
+    vand = [[_pow(r, c) for c in range(d)] for r in range(d + p)]
+    top_inv = _mat_inv([row[:d] for row in vand[:d]])
+    return _mat_mul(vand, top_inv)
+
+
+def test_independent_field_self_checks():
+    """The independent arithmetic is itself sanity-anchored before being
+    used as a judge: published antilog prefix, inverses, distributivity
+    fuzz with a fixed seed."""
+    assert [_pow(2, i) for i in range(16)] == ANTILOG_0X11D_PREFIX
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+        assert _mul(a, b) == _mul(b, a)
+        assert _mul(a, b ^ c) == _mul(a, b) ^ _mul(a, c)
+        if a:
+            assert _mul(a, _inv(a)) == 1
+
+
+@pytest.mark.parametrize("d", [1, 2, 3, 4, 5, 8, 10, 16, 20])
+@pytest.mark.parametrize("p", [0, 1, 2, 4, 6])
+def test_encode_matrix_matches_independent_impl(d, p):
+    got = matrix.build_encode_matrix(d, p)
+    want = _encode_matrix(d, p)
+    assert got.tolist() == want
+    # systematic: identity on top
+    for i in range(d):
+        assert want[i] == [1 if j == i else 0 for j in range(d)]
+
+
+def test_decode_matrix_matches_independent_impl():
+    """The reconstruction convention (invert the submatrix of the first d
+    surviving rows, multiply by the wanted rows) re-derived
+    independently."""
+    d, p = 10, 4
+    enc = matrix.build_encode_matrix(d, p)
+    ind = _encode_matrix(d, p)
+    present = [2, 3, 4, 5, 6, 7, 8, 9, 10, 12]  # 0, 1, 11, 13 lost
+    wanted = [0, 1, 11, 13]
+    got = matrix.decode_matrix(enc, present, wanted)
+    sub_inv = _mat_inv([ind[i] for i in present[:d]])
+    want = _mat_mul([ind[i] for i in wanted], sub_inv)
+    assert got.tolist() == want
+
+
+def test_independent_end_to_end_reconstruction():
+    """Encode with the production coder, erase p shards, rebuild with
+    ONLY the independent implementation — the strongest cross-check:
+    production parity must be decodable by an outsider that shares no
+    code with it."""
+    from chunky_bits_tpu.ops.backend import ErasureCoder, NumpyBackend
+
+    d, p, size = 5, 3, 64
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, (1, d, size), dtype=np.uint8)
+    coder = ErasureCoder(d, p, NumpyBackend())
+    parity = coder.encode_batch(data)
+    full = [list(map(int, data[0, i])) for i in range(d)] + \
+        [list(map(int, parity[0, i])) for i in range(p)]
+
+    lost = [0, 2, 4]
+    present = [i for i in range(d + p) if i not in lost]
+    ind = _encode_matrix(d, p)
+    sub_inv = _mat_inv([ind[i] for i in present[:d]])
+    rows = _mat_mul([ind[i] for i in lost], sub_inv)
+    for li, row in zip(lost, rows):
+        rebuilt = [0] * size
+        for coef, src in zip(row, (full[i] for i in present[:d])):
+            for s in range(size):
+                rebuilt[s] ^= _mul(coef, src[s])
+        assert rebuilt == full[li], f"shard {li}"
+
+
+def test_mds_property_sampled():
+    """Any d of the d+p encode rows must be invertible (the MDS guarantee
+    the crate's reconstruct relies on) — sampled subsets across
+    geometries."""
+    rng = np.random.default_rng(9)
+    for d, p in [(3, 2), (4, 2), (10, 4), (20, 6)]:
+        enc = matrix.build_encode_matrix(d, p).tolist()
+        for _ in range(10):
+            rows = sorted(rng.choice(d + p, size=d, replace=False).tolist())
+            _mat_inv([enc[i] for i in rows])  # raises if singular
