@@ -1,0 +1,51 @@
+"""Worker for the two-process jax.distributed smoke test
+(test_parallel.py::test_two_process_distributed_encode).
+
+Each worker joins a localhost coordinator via ``init_multihost``'s
+explicit-args path, takes its ``partition_parts`` slice of a shared
+deterministic part batch, encodes it on a mesh over its own local
+devices, and writes parity + the psum checksum to an .npz for the parent
+to verify against the oracle.  Run:
+
+    python mh_worker.py <coordinator_port> <process_id> <n_procs> <out.npz>
+"""
+
+import sys
+
+
+def main() -> None:
+    port, pid, nprocs, out_path = (sys.argv[1], int(sys.argv[2]),
+                                   int(sys.argv[3]), sys.argv[4])
+
+    import numpy as np
+
+    from chunky_bits_tpu.ops import matrix
+    from chunky_bits_tpu.parallel import (
+        encode_step_sharded,
+        init_multihost,
+        local_mesh,
+        partition_parts,
+    )
+
+    idx, count = init_multihost(f"127.0.0.1:{port}", num_processes=nprocs,
+                                process_id=pid)
+    assert (idx, count) == (pid, nprocs), (idx, count)
+    # idempotent re-entry must keep reporting the distributed topology
+    assert init_multihost() == (pid, nprocs)
+
+    d, p, size, total = 4, 2, 256, 12
+    enc = matrix.build_encode_matrix(d, p)
+    # same seed in every process: the global batch is shared state, each
+    # process encodes only its dealt slice
+    data = np.random.default_rng(77).integers(
+        0, 256, (total, d, size), dtype=np.uint8)
+    lo, hi = partition_parts(total)
+    mesh = local_mesh(sp=2)
+    parity, checksum = encode_step_sharded(mesh, enc, data[lo:hi])
+    np.savez(out_path, lo=lo, hi=hi, parity=np.asarray(parity),
+             checksum=int(checksum))
+    print(f"worker {pid}: parts [{lo}, {hi}) ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()
