@@ -77,16 +77,23 @@ class FileReadBuilder:
         from chunky_bits_tpu.ops.batching import ReconstructBatcher
 
         batcher = ReconstructBatcher(backend=self.backend)
+        remaining = self.len_bytes()
         jobs: list[tuple[FilePart, int]] = []
         seek = self.seek
+        budget = remaining
         for part in self.file.parts:
+            if budget <= 0:
+                # parts wholly past the take window are never read: a
+                # take-limited stream must not touch (or depend on the
+                # health of) trailing parts the caller never asked for
+                break
             part_len = part.len_bytes()
             if seek >= part_len and seek != 0:
                 seek -= part_len
                 continue
             jobs.append((part, seek))
+            budget -= part_len - seek
             seek = 0
-        remaining = self.len_bytes()
         tasks: deque[asyncio.Task] = deque()
         idx = 0
         try:
@@ -103,6 +110,8 @@ class FileReadBuilder:
                 remaining -= len(data)
                 if data:
                     yield data
+                if remaining <= 0:
+                    break
         finally:
             for t in tasks:
                 t.cancel()

@@ -183,8 +183,22 @@ def make_app(cluster: Cluster,
         await resp.prepare(request)
         if request.method == "HEAD":
             return resp
-        async for chunk in builder.stream():
-            await resp.write(chunk)
+        try:
+            async for chunk in builder.stream():
+                await resp.write(chunk)
+        except ChunkyBitsError as err:
+            # Degraded beyond repair (>p chunks gone) or a storage-node
+            # failure mid-file.  Status and Content-Length are already on
+            # the wire, so the only honest signal left is an aborted
+            # connection — the client sees a short body, never a clean
+            # EOF that would pass truncated data off as the object.
+            # Detail goes to the log only (error text can embed internal
+            # node URLs / filesystem paths).
+            log.error("GET %s aborted mid-stream: %s", path, err)
+            resp.force_close()
+            if request.transport is not None:
+                request.transport.close()
+            return resp
         await resp.write_eof()
         return resp
 

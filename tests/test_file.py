@@ -162,6 +162,56 @@ def test_streamed_staging_roundtrip(tmp_path):
     asyncio.run(main())
 
 
+def test_take_limited_read_ignores_trailing_parts(tmp_path):
+    """A take-limited read must neither touch nor depend on parts past
+    its window: destroy every chunk of the last part and the windowed
+    read still succeeds — while a full read correctly fails."""
+    from chunky_bits_tpu.errors import FileReadError
+    from chunky_bits_tpu.file import file_part as fp_mod
+
+    d_, p_, chunk = 3, 2, 1024
+    payload = synthetic_bytes(d_ * chunk * 4, seed=47)  # exactly 4 parts
+    dirs = []
+    for i in range(5):
+        dd = tmp_path / f"disk{i}"
+        dd.mkdir()
+        dirs.append(Location.parse(str(dd)))
+
+    async def main():
+        ref = await (FileWriteBuilder()
+                     .with_destination(LocationsDestination(dirs))
+                     .with_chunk_size(chunk)
+                     .with_data_chunks(d_)
+                     .with_parity_chunks(p_)
+                     .write(aio.BytesReader(payload)))
+        assert len(ref.parts) == 4
+        for c in ref.parts[3].all_chunks():
+            os.remove(c.locations[0].target)
+
+        reads = []
+        orig = fp_mod.FilePart.read
+
+        async def counting(self, *a, **kw):
+            reads.append(self)
+            return await orig(self, *a, **kw)
+
+        fp_mod.FilePart.read = counting
+        try:
+            part_bytes = d_ * chunk
+            got = await (FileReadBuilder(ref).with_seek(100)
+                         .with_take(part_bytes).read_all())
+            assert got == payload[100:100 + part_bytes]
+            # only the two parts overlapping the window were read
+            assert len(reads) == 2
+        finally:
+            fp_mod.FilePart.read = orig
+
+        with pytest.raises(FileReadError):
+            await FileReadBuilder(ref).read_all()
+
+    asyncio.run(main())
+
+
 def test_writer_owns_batcher_for_merging_backend(tmp_path):
     """A merge-preferring (device) backend with no shared batcher gets a
     writer-owned EncodeHashBatcher, so streamed sub-blocks coalesce back

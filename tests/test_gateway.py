@@ -29,6 +29,80 @@ def make_cluster(tmp_path) -> Cluster:
     })
 
 
+def test_get_aborts_cleanly_when_degraded_beyond_repair(tmp_path, caplog):
+    """A mid-stream read failure (>p chunks of a later part gone) must
+    abort the connection — never deliver a truncated body as a clean
+    200 EOF, never kill the server: follow-up requests still work."""
+    import aiohttp
+
+    # make_cluster: chunk_size 2^16, d=3 => 192 KiB parts; 4 parts
+    part_bytes = 3 * (1 << 16)
+    payload = os.urandom(3 * part_bytes + 5000)
+
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        cluster = make_cluster(tmp_path)
+        app = make_app(cluster)
+        # no connection pooling: the server force-closes aborted streams'
+        # connections, which would poison pooled reuse
+        async with TestClient(
+                TestServer(app),
+                connector=aiohttp.TCPConnector(force_close=True)) as client:
+            assert (await client.put("/obj/x", data=payload)).status == 200
+            ref = await cluster.get_file_ref("obj/x")
+            # destroy all 5 chunks of the SECOND part: the stream serves
+            # part 0 fine, then hits an unreconstructable part
+            for chunk in ref.parts[1].all_chunks():
+                os.remove(chunk.locations[0].target)
+
+            # unranged GET: headers flow, then the connection aborts
+            with pytest.raises(aiohttp.ClientError):
+                resp = await client.get("/obj/x")
+                assert resp.status == 200
+                body = await resp.read()
+                # if the transport delivered everything buffered before
+                # the abort, it must still be short, not a clean body
+                assert len(body) < len(payload)
+                raise aiohttp.ClientPayloadError("short body")
+
+            # ranged GET over the broken part aborts too
+            lo, hi = part_bytes, 2 * part_bytes - 1
+            with pytest.raises(aiohttp.ClientError):
+                resp = await client.get(
+                    "/obj/x",
+                    headers={"Range": f"bytes={lo}-{hi}"})
+                assert resp.status == 206
+                body = await resp.read()
+                assert len(body) < hi - lo + 1
+                raise aiohttp.ClientPayloadError("short body")
+
+            # a range entirely inside the intact first part still works
+            resp = await client.get(
+                "/obj/x", headers={"Range": "bytes=1000-2999"})
+            assert resp.status == 206
+            assert await resp.read() == payload[1000:3000]
+
+            # ...and cleanly: a take-limited stream must not read (or
+            # abort on) broken parts PAST its window
+            with caplog.at_level("ERROR", "chunky_bits_tpu.gateway"):
+                caplog.clear()
+                resp = await client.get(
+                    "/obj/x", headers={"Range": "bytes=0-999"})
+                assert resp.status == 206
+                assert await resp.read() == payload[:1000]
+                assert not [r for r in caplog.records
+                            if "aborted mid-stream" in r.message]
+
+            # the server survives: an unrelated full roundtrip succeeds
+            assert (await client.put("/obj/y",
+                                     data=b"still alive")).status == 200
+            resp = await client.get("/obj/y")
+            assert await resp.read() == b"still alive"
+
+    asyncio.run(main())
+
+
 def test_parse_http_range():
     assert parse_http_range("bytes=0-99") == ("range", 0, 99)
     assert parse_http_range("bytes=500-") == ("prefix", 500)
