@@ -370,7 +370,7 @@ def bench_batched_repair() -> None:
     }))
 
 
-def bench_small_objects() -> None:
+def bench_small_objects(argv=()) -> None:
     """BASELINE.md config 4's compute core: many concurrent small-object
     encodes (d=8 p=3, 4 MiB objects => [1, 8, S] batches) coalescing
     through the shared EncodeHashBatcher.  Reports aggregate ingest-side
@@ -379,6 +379,16 @@ def bench_small_objects() -> None:
     import os
 
     from chunky_bits_tpu.ops.batching import EncodeHashBatcher
+
+    # --threads N caps the native engine's host threads ("native:N");
+    # default uses every core, so the metric scales with the host
+    backend = None
+    if "--threads" in argv:
+        idx = list(argv).index("--threads") + 1
+        if idx >= len(argv):
+            print("usage: bench.py --config 4 --threads N", file=sys.stderr)
+            sys.exit(2)
+        backend = "native:" + argv[idx]
 
     d, p = 8, 3
     obj_bytes = 4 << 20
@@ -389,7 +399,7 @@ def bench_small_objects() -> None:
             for _ in range(n_objects)]
 
     async def run() -> float:
-        batcher = EncodeHashBatcher()
+        batcher = EncodeHashBatcher(backend=backend)
         sem = asyncio.Semaphore(16)  # gateway-like request concurrency
 
         async def one(stacked):
@@ -412,7 +422,8 @@ def bench_small_objects() -> None:
 
     gib = asyncio.run(run())
     print(json.dumps({
-        "metric": "bulk_ingest_encode_hash_gibps_d8p3_4mib_objs",
+        "metric": "bulk_ingest_encode_hash_gibps_d8p3_4mib_objs"
+                  + (f"_{backend.replace(':', '')}" if backend else ""),
         "value": round(gib, 2), "unit": "GiB/s",
         "vs_baseline": round(gib / 5.0, 2),
     }))
@@ -425,7 +436,7 @@ if __name__ == "__main__":
         configs = {"1": bench_cpu_reference,
                    "2": lambda: bench_cp_pipeline(sys.argv),
                    "3": bench_batched_repair,
-                   "4": bench_small_objects}
+                   "4": lambda: bench_small_objects(sys.argv)}
         idx = sys.argv.index("--config") + 1
         which = sys.argv[idx] if idx < len(sys.argv) else ""
         if which not in configs:

@@ -6,7 +6,8 @@ existing file with the right name is already correct), ``user_agent``, plus
 the erasure ``backend`` selection (this framework's addition — the
 north-star's cluster.yaml switch between cpu and TPU erasure backends).
 
-``backend`` names: ``numpy`` / ``native`` / ``jax`` (single device) /
+``backend`` names: ``numpy`` / ``native`` (C++, all host cores) /
+``native:4`` (C++ capped at 4 threads) / ``jax`` (single device) /
 ``jax:dp4,sp2`` / ``jax:tp4`` (device-mesh sharded; parallel/backend.py).
 """
 

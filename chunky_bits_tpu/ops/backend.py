@@ -96,6 +96,19 @@ def get_backend(name: Optional[str] = None) -> ErasureBackend:
         from chunky_bits_tpu.ops.cpu_backend import NativeBackend
 
         backend = NativeBackend()
+    elif name.startswith("native:"):
+        # explicit host thread count, e.g. "native:4" — bounds the C++
+        # codec/hasher's std::thread fan-out (plain "native" uses
+        # hardware_concurrency); the knob cluster.yaml tunables expose
+        # for hosts shared with other work
+        from chunky_bits_tpu.ops.cpu_backend import NativeBackend
+
+        spec = name[len("native:"):]
+        if not spec.isdigit() or int(spec) < 1:
+            raise ErasureError(
+                f"bad native thread count {spec!r} (want e.g. native:4)")
+        backend = NativeBackend(nthreads=int(spec))
+        backend.name = name
     elif name == "jax":
         from chunky_bits_tpu.ops.jax_backend import JaxBackend
 

@@ -60,3 +60,35 @@ def test_native_large_batch_threads():
     got = ErasureCoder(3, 2, be).encode_batch(data)
     want = ErasureCoder(3, 2, NumpyBackend()).encode_batch(data)
     assert np.array_equal(got, want)
+
+
+def test_native_thread_knob_spec():
+    """'native:N' caps the C++ engine's host threads (the cluster.yaml
+    tunables surface for shared hosts); results stay byte-identical and
+    bad specs fail with a clear message."""
+    from chunky_bits_tpu.errors import ErasureError
+
+    try:
+        be2 = get_backend("native:2")
+    except ErasureError:
+        raise
+    except Exception as err:  # pragma: no cover
+        pytest.skip(f"native backend unavailable: {err}")
+    assert be2.name == "native:2"
+    assert be2.nthreads == 2
+    assert get_backend("native:2") is be2  # registry round-trip
+    assert get_backend("native").nthreads == 0  # plain spelling: auto
+
+    d, p = 5, 3
+    rng = np.random.default_rng(21)
+    data = rng.integers(0, 256, (7, d, 2048), dtype=np.uint8)
+    want = ErasureCoder(d, p, NumpyBackend()).encode_batch(data)
+    assert np.array_equal(ErasureCoder(d, p, be2).encode_batch(data), want)
+    parity, digests = ErasureCoder(d, p, be2).encode_hash_batch(data)
+    assert np.array_equal(parity, want)
+    import hashlib
+    assert digests[3, 1].tobytes() == hashlib.sha256(data[3, 1]).digest()
+
+    for bad in ("native:", "native:0", "native:-2", "native:x"):
+        with pytest.raises(ErasureError, match="thread count"):
+            get_backend(bad)
