@@ -19,11 +19,16 @@ Kernel math (per grid cell, shapes static):
 The matmul runs on the int8 MXU path (v5e executes int8 at 2x the bf16
 rate, and the int8 bit-planes halve VMEM traffic vs bf16).  Hoist-proof
 marginal measurement (bench.py method) on one v5e chip at d=10 p=4,
-1 MiB chunks, batch 128: ~57-60 GiB/s sustained (two parts per grid
+1 MiB chunks, batch 128: ~55-60 GiB/s sustained (two parts per grid
 cell; tile/bblock swept on-chip), ~10% above the bf16 variant.  Variants
 tried and rejected as slower on-chip: packed-word unpack via sublane
 bitcast (~53), Kronecker-segmented matmul filling the MXU M dimension
-(~53); int4 operands are unsupported by the runtime.
+(~53); int4 operands are unsupported by the runtime.  Round-4 re-sweep
+(tile 8/16/32 KiB x bblock 1/2/4): flat plateau 51.5-54.6 with the
+current (32 KiB, 2) at the top — no headroom left in these knobs; the
+M=R8 dimension (32 rows at p=4) structurally caps MXU row utilization,
+and block-diagonal multi-part stacking trades utilization for zero
+FLOPs one-for-one, so it was not pursued.
 Accumulation is exact — each dot sums at most K8 ones, far below 2^31.
 """
 
