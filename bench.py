@@ -222,8 +222,12 @@ def bench_cp_pipeline(argv: list) -> None:
     blob = np.random.default_rng(0).integers(
         0, 256, 16 * part_bytes, dtype=np.uint8).tobytes()
 
+    blob_view = memoryview(blob)
+
     class CyclicReader:
-        """Constant-memory synthetic stream: serves views of one blob."""
+        """Constant-memory synthetic stream: serves views of one blob.
+        ``readinto`` lands bytes straight in the writer's staging block
+        (one source copy), like a real file/socket reader would."""
 
         def __init__(self, total_bytes: int):
             self.remaining = total_bytes
@@ -239,6 +243,15 @@ def bench_cp_pipeline(argv: list) -> None:
             self.off = (self.off + n) % len(blob)
             self.remaining -= n
             return data
+
+        async def readinto(self, mem) -> int:
+            if self.remaining <= 0:
+                return 0
+            n = min(len(mem), self.remaining, len(blob) - self.off)
+            mem[:n] = blob_view[self.off:self.off + n]
+            self.off = (self.off + n) % len(blob)
+            self.remaining -= n
+            return n
 
     class NoHashBatcher(EncodeHashBatcher):
         """Parity on the device, zero digests: isolates the pipeline

@@ -14,15 +14,21 @@ dispatches (grouped by shard length, so the full-size stripes share one
 fail-fast error path.
 
 Staging streams: parts are handed to encode in sub-blocks of
-``stage_parts`` (default 8) as they fill, so the read loop, the staging
-copy, the device encode, and the destination writes all overlap — a large
-``batch_parts`` raises the *dispatch* coalescing bound (an
-EncodeHashBatcher — the caller's shared one, or one the writer creates
-for merge-preferring device backends — merges concurrent sub-blocks into
-one [ΣB, d, S] dispatch), not the amount of data serialized behind a
-single staging copy.  Round-2 measurement of the unstreamed design:
-batch=256 collapsed to 0.09 GiB/s because 2.5 GiB sat in buffers while
-nothing encoded or wrote.
+``stage_parts`` (default 8) as they fill, so the read loop, the device
+encode, and the destination writes all overlap — a large ``batch_parts``
+raises the *dispatch* coalescing bound (an EncodeHashBatcher — the
+caller's shared one, or one the writer creates for merge-preferring
+device backends — merges concurrent sub-blocks into one [ΣB, d, S]
+dispatch), not the amount of data serialized behind a single staging
+copy.  Round-2 measurement of the unstreamed design: batch=256 collapsed
+to 0.09 GiB/s because 2.5 GiB sat in buffers while nothing encoded or
+wrote.
+
+Zero-restage ingest: the read loop lands part bytes directly into rows
+of the [stage_size, d, chunk] staging block (``aio.read_exact_into``,
+zero-copy for ``readinto``-capable readers), so full-length parts reach
+the encoder already in batched device layout with no intermediate bytes
+objects or restaging memcpy; only the short tail part is repacked.
 """
 
 from __future__ import annotations
@@ -136,19 +142,27 @@ class FileWriteBuilder:
         # what the batcher merges into full-size dispatches.
         encode_ahead = asyncio.Semaphore(
             max(2 * stage_size, batch_parts if merging else 0))
-        staged: list[tuple[bytes, int]] = []  # (buffer, meaningful length)
+        chunk = self.chunk_size
+        part_bytes = d * chunk
+        # The current staging block: the read loop lands part bytes
+        # DIRECTLY into rows of this [stage_size, d, chunk] array (via
+        # readinto when the reader supports it), so a full-length part
+        # reaches the encoder with zero restaging copies — the bytes are
+        # already in batched [B, d, S] device layout.
+        block: Optional[np.ndarray] = None
+        lens: list[int] = []
         total_bytes = 0
 
-        def stage(items: list[tuple[bytes, int]]):
-            """Group staged parts by shard length and copy each part
-            buffer exactly once into a preallocated [B, d, S] staging
-            array per group; the shard payloads later handed to the
-            writers are zero-copy row views of that array (and of the
-            parity batch), so the ingest path moves each byte host-side
-            only twice: reader -> staging, staging -> destination.  Runs
-            in a worker thread."""
+        def stage(blk: np.ndarray, ls: list[int]):
+            """Group a staging block's parts by shard length.  The
+            common group — full-length parts, which the read loop already
+            laid out back-to-back — is handed to encode as a zero-copy
+            slice view of the block; only a short tail part (at most one
+            per write: a short read ends the stream) is repacked to its
+            smaller shard length with zero padding.  Runs in a worker
+            thread for the repack memcpy."""
             groups: dict[int, list[int]] = {}
-            for i, (buf, length) in enumerate(items):
+            for i, length in enumerate(ls):
                 shard_len = (length + d - 1) // d
                 groups.setdefault(shard_len, []).append(i)
             staged_groups = []
@@ -156,25 +170,37 @@ class FileWriteBuilder:
                 if shard_len == 0:
                     staged_groups.append((0, indices, None))
                     continue
+                if shard_len == chunk:
+                    # split full-length parts out first: a near-full tail
+                    # (within d-1 bytes of part_bytes) shares this
+                    # shard_len but needs zero padding, and must not drag
+                    # the full parts through the repack
+                    full = [i for i in indices if ls[i] == part_bytes]
+                    if full and full[-1] + 1 - full[0] == len(full):
+                        staged_groups.append(
+                            (chunk, full, blk[full[0]:full[-1] + 1]))
+                        indices = [i for i in indices
+                                   if ls[i] != part_bytes]
+                        if not indices:
+                            continue
                 stacked = np.empty((len(indices), d, shard_len),
                                    dtype=np.uint8)
                 for bi, i in enumerate(indices):
-                    buf, length = items[i]
+                    length = ls[i]
                     flat = stacked[bi].reshape(d * shard_len)
-                    flat[:length] = np.frombuffer(buf, dtype=np.uint8,
-                                                  count=length)
+                    flat[:length] = blk[i].reshape(-1)[:length]
                     if length < d * shard_len:
                         flat[length:] = 0
                 staged_groups.append((shard_len, indices, stacked))
             return staged_groups
 
-        async def encode_staged(items: list[tuple[bytes, int]]):
+        async def encode_staged(blk: np.ndarray, ls: list[int]):
             """Encode + hash a batch of parts; same-shard-length stripes
             share one dispatch (and one fused native encode+hash pass).
             With a shared encode batcher, the dispatch additionally
             coalesces with other concurrent writes (many-small-files /
             gateway ingest)."""
-            groups = await asyncio.to_thread(stage, items)
+            groups = await asyncio.to_thread(stage, blk, ls)
             results: dict[int, tuple[list, list, int, Optional[list]]] = {}
 
             async def encode_group(shard_len, indices, stacked):
@@ -198,7 +224,7 @@ class FileWriteBuilder:
 
             await aio.gather_or_cancel(
                 [encode_group(*g) for g in groups])
-            return [results[i] for i in range(len(items))]
+            return [results[i] for i in range(len(ls))]
 
         async def write_part(precomputed) -> FilePart:
             try:
@@ -210,29 +236,31 @@ class FileWriteBuilder:
 
         batch_tasks: list[asyncio.Task] = []
 
-        async def run_batch(items) -> list[FilePart]:
+        async def run_batch(blk, ls) -> list[FilePart]:
             try:
-                pre = await encode_staged(items)
+                pre = await encode_staged(blk, ls)
             except BaseException:
-                for _ in items:
+                for _ in ls:
                     sem.release()
                     encode_ahead.release()
                 raise
-            # raw buffers are consumed; let the read loop stage the next
+            # staging block consumed; let the read loop fill the next
             # sub-block while these parts flow to the destination
-            for _ in items:
+            for _ in ls:
                 encode_ahead.release()
             return await aio.gather_or_cancel(
                 [write_part(x) for x in pre])
 
         def flush() -> None:
-            """Hand the staged parts to a background encode+write task —
-            the read loop keeps streaming while the previous batch is on
-            the device / in flight to storage (double buffering; the
-            semaphore still bounds total parts in flight)."""
-            items, staged[:] = staged[:], []
-            if items:
-                batch_tasks.append(asyncio.create_task(run_batch(items)))
+            """Hand the current staging block to a background
+            encode+write task — the read loop keeps streaming into a
+            fresh block while the previous one is on the device / in
+            flight to storage (double buffering; the semaphore still
+            bounds total parts in flight)."""
+            nonlocal block, lens
+            blk, ls, block, lens = block, lens, None, []
+            if ls:
+                batch_tasks.append(asyncio.create_task(run_batch(blk, ls)))
 
         checked = 0
 
@@ -262,16 +290,19 @@ class FileWriteBuilder:
             while True:
                 await sem.acquire()
                 await encode_ahead.acquire()
-                buf = await aio.read_exact_or_eof(
-                    reader, d * self.chunk_size)
-                if not buf:
+                if block is None:
+                    block = np.empty((stage_size, d, chunk),
+                                     dtype=np.uint8)
+                got = await aio.read_exact_into(
+                    reader, memoryview(block[len(lens)].reshape(-1)))
+                if got == 0:
                     sem.release()
                     encode_ahead.release()
                     break
-                total_bytes += len(buf)
-                staged.append((buf, len(buf)))
-                short_read = len(buf) < d * self.chunk_size
-                if len(staged) >= stage_size or short_read:
+                total_bytes += got
+                lens.append(got)
+                short_read = got < part_bytes
+                if len(lens) >= stage_size or short_read:
                     # the just-staged parts keep their permits until their
                     # write tasks complete
                     flush()

@@ -32,6 +32,9 @@ class BytesReader:
     async def read(self, n: int = -1) -> bytes:
         return self._buf.read(n)
 
+    async def readinto(self, mem: memoryview) -> int:
+        return self._buf.readinto(mem)
+
 
 class FileReader:
     """Thread-offloaded file reader (the spawn_blocking analogue)."""
@@ -53,6 +56,10 @@ class FileReader:
     async def read(self, n: int = -1) -> bytes:
         f = await self._ensure()
         return await asyncio.to_thread(f.read, n)
+
+    async def readinto(self, mem: memoryview) -> int:
+        f = await self._ensure()
+        return await asyncio.to_thread(f.readinto, mem)
 
     async def close(self) -> None:
         if self._f is not None:
@@ -200,6 +207,34 @@ async def read_exact_or_eof(reader: AsyncByteReader, n: int) -> bytes:
         chunks.append(data)
         got += len(data)
     return b"".join(chunks)
+
+
+async def read_exact_into(reader: AsyncByteReader, mem: memoryview) -> int:
+    """Fill ``mem`` until full or EOF; returns bytes filled.
+
+    The zero-extra-copy variant of ``read_exact_or_eof`` for callers
+    that own a destination buffer (the writer's staging block): a
+    reader exposing ``async readinto(mem) -> int`` lands bytes directly
+    in place; otherwise each ``read()`` chunk is copied straight into
+    position — one pass either way, where read_exact_or_eof costs a
+    join pass plus the caller's restage pass."""
+    n = len(mem)
+    got = 0
+    readinto = getattr(reader, "readinto", None)
+    if readinto is not None:
+        while got < n:
+            filled = await readinto(mem[got:])
+            if not filled:
+                break
+            got += filled
+        return got
+    while got < n:
+        data = await reader.read(n - got)
+        if not data:
+            break
+        mem[got:got + len(data)] = data
+        got += len(data)
+    return got
 
 
 async def copy_reader_to_file(reader: AsyncByteReader, path: str,

@@ -122,13 +122,17 @@ def test_roundtrip_with_storage(tmp_path, batch_parts):
     asyncio.run(main())
 
 
-def test_streamed_staging_roundtrip(tmp_path):
+@pytest.mark.parametrize("tail", [500, 3 * 1024 - 2, 3 * 1024])
+def test_streamed_staging_roundtrip(tmp_path, tail):
     """batch_parts larger than the staging granularity streams sub-blocks
     through encode while the read loop continues; part order, lengths,
-    and bytes must be exactly the serial path's."""
+    and bytes must be exactly the serial path's.  Tail variants: short
+    (repacked to a smaller shard length), near-full (same shard length
+    as full parts but needing zero padding — must not drag the full
+    parts off the zero-copy path), and exactly full."""
     d, p, chunk = 3, 2, 1024
     n_parts = 21
-    payload = synthetic_bytes(d * chunk * (n_parts - 1) + 500, seed=41)
+    payload = synthetic_bytes(d * chunk * (n_parts - 1) + tail, seed=41)
     dirs = []
     for i in range(5):
         dd = tmp_path / f"disk{i}"
