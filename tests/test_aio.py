@@ -1,0 +1,105 @@
+"""Unit tests for the async byte substrate (utils/aio.py) — focused on
+``read_exact_into``, the zero-restage ingest primitive the writer's
+staging block depends on (readinto fast path, read() fallback, partial
+fills, EOF)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from chunky_bits_tpu.utils import aio
+
+
+class DribbleReader:
+    """read()-only reader serving at most ``step`` bytes per call."""
+
+    def __init__(self, data: bytes, step: int):
+        self._data = data
+        self._off = 0
+        self._step = step
+        self.calls = 0
+
+    async def read(self, n: int = -1) -> bytes:
+        self.calls += 1
+        if self._off >= len(self._data):
+            return b""
+        n = min(n if n >= 0 else self._step, self._step,
+                len(self._data) - self._off)
+        out = self._data[self._off:self._off + n]
+        self._off += n
+        return out
+
+
+class DribbleIntoReader(DribbleReader):
+    """readinto-capable variant with the same dribble behavior."""
+
+    async def readinto(self, mem: memoryview) -> int:
+        self.calls += 1
+        if self._off >= len(self._data):
+            return 0
+        n = min(len(mem), self._step, len(self._data) - self._off)
+        mem[:n] = self._data[self._off:self._off + n]
+        self._off += n
+        return n
+
+
+@pytest.mark.parametrize("cls", [DribbleReader, DribbleIntoReader])
+@pytest.mark.parametrize("step", [1, 7, 64, 1000])
+def test_read_exact_into_fills_exactly(cls, step):
+    data = bytes(range(256)) * 4  # 1024 bytes
+    buf = np.zeros(600, dtype=np.uint8)
+
+    async def main():
+        reader = cls(data, step)
+        got = await aio.read_exact_into(reader, memoryview(buf))
+        assert got == 600
+        assert buf.tobytes() == data[:600]
+        # second fill continues from where the reader left off
+        buf2 = np.zeros(600, dtype=np.uint8)
+        got = await aio.read_exact_into(reader, memoryview(buf2))
+        assert got == len(data) - 600  # EOF short fill
+        assert buf2.tobytes()[:got] == data[600:]
+        # at EOF: zero filled
+        assert await aio.read_exact_into(reader, memoryview(buf2)) == 0
+
+    asyncio.run(main())
+
+
+def test_read_exact_into_prefers_readinto():
+    data = b"x" * 100
+
+    async def main():
+        reader = DribbleIntoReader(data, 1000)
+        buf = np.zeros(100, dtype=np.uint8)
+        await aio.read_exact_into(reader, memoryview(buf))
+        assert buf.tobytes() == data
+
+    asyncio.run(main())
+
+
+def test_builtin_readers_readinto():
+    """BytesReader and FileReader expose the zero-copy path."""
+
+    async def main():
+        data = bytes(range(200))
+        buf = np.zeros(200, dtype=np.uint8)
+        r = aio.BytesReader(data)
+        assert await aio.read_exact_into(r, memoryview(buf)) == 200
+        assert buf.tobytes() == data
+
+    asyncio.run(main())
+
+
+def test_file_reader_readinto(tmp_path):
+    async def main():
+        data = bytes(range(256)) * 3
+        path = tmp_path / "f.bin"
+        path.write_bytes(data)
+        r = aio.FileReader(str(path), offset=100)
+        buf = np.zeros(500, dtype=np.uint8)
+        assert await aio.read_exact_into(r, memoryview(buf)) == 500
+        assert buf.tobytes() == data[100:600]
+        await r.close()
+
+    asyncio.run(main())
