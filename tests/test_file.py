@@ -166,6 +166,42 @@ def test_streamed_staging_roundtrip(tmp_path, tail):
     asyncio.run(main())
 
 
+def test_write_fails_cleanly_on_reader_error(tmp_path):
+    """A source reader erroring mid-stream must abort the write with the
+    original exception, cancel in-flight batches, and not leak parts."""
+
+    class ExplodingReader:
+        def __init__(self, good_bytes: int):
+            self._left = good_bytes
+
+        async def read(self, n: int = -1) -> bytes:
+            if self._left <= 0:
+                raise OSError("source went away")
+            n = min(n if n >= 0 else self._left, self._left)
+            self._left -= n
+            return b"\x5a" * n
+
+    dirs = []
+    for i in range(5):
+        dd = tmp_path / f"disk{i}"
+        dd.mkdir()
+        dirs.append(Location.parse(str(dd)))
+
+    async def main():
+        builder = (FileWriteBuilder()
+                   .with_destination(LocationsDestination(dirs))
+                   .with_chunk_size(1024)
+                   .with_data_chunks(3)
+                   .with_parity_chunks(2)
+                   .with_batch_parts(8)
+                   .with_stage_parts(2)
+                   .with_concurrency(12))
+        with pytest.raises(OSError, match="source went away"):
+            await builder.write(ExplodingReader(5 * 3 * 1024))
+
+    asyncio.run(main())
+
+
 def test_take_limited_read_ignores_trailing_parts(tmp_path):
     """A take-limited read must neither touch nor depend on parts past
     its window: destroy every chunk of the last part and the windowed
