@@ -287,9 +287,22 @@ class ErasureCoder:
         rows.  Returns [B, len(wanted), S].
         """
         present = sorted(present)
+        picked = shards[:, np.array(present[: self.data], dtype=np.intp), :]
+        return self.reconstruct_batch_picked(picked, present, wanted)
+
+    def reconstruct_batch_picked(
+        self, picked: np.ndarray, present: Sequence[int],
+        wanted: Sequence[int],
+    ) -> np.ndarray:
+        """Like ``reconstruct_batch`` but over shards already gathered in
+        decode layout: ``picked[B, d, S]`` holds the rows at
+        ``sorted(present)[:d]``, in that order.  Callers that assemble
+        the batch themselves (ops/batching.py) stack straight into this
+        layout, skipping the full [B, d+p, S] scatter plus the row-pick
+        copy that reconstruct_batch would redo."""
+        present = sorted(present)
         dec = matrix.decode_matrix(self.encode_matrix, list(present),
                                    list(wanted))
-        picked = shards[:, np.array(present[: self.data], dtype=np.intp), :]
         return self.backend.apply_matrix(dec, picked)
 
     # ---- per-part API mirroring the crate ----

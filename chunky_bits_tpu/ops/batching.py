@@ -151,13 +151,16 @@ class ReconstructBatcher(_CoalescingBatcher):
         d, p, present, wanted, size = key
         self.dispatches += 1
         coder = get_coder(d, p, self.backend)
-        # empty, not zeros: reconstruct_batch reads only present[:d] rows
-        stacked = np.empty((len(requests), d + p, size), dtype=np.uint8)
+        # stack straight into decode layout (the first d present rows,
+        # ascending) — one gather pass instead of a full [B, d+p, S]
+        # scatter followed by reconstruct_batch's row-pick copy
+        use = sorted(present)[:d]
+        picked = np.empty((len(requests), d, size), dtype=np.uint8)
         for bi, arrays in enumerate(requests):
-            for i in present:
-                stacked[bi, i] = arrays[i]
-        rebuilt = coder.reconstruct_batch(stacked, list(present),
-                                          list(wanted))
+            for j, i in enumerate(use):
+                picked[bi, j] = arrays[i]
+        rebuilt = coder.reconstruct_batch_picked(picked, list(present),
+                                                 list(wanted))
         out: list[list] = []
         for bi, arrays in enumerate(requests):
             filled = list(arrays)
