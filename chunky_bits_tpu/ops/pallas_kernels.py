@@ -80,11 +80,14 @@ def _build_kernel(r: int, k: int, tile_s: int, bblock: int, interpret: bool,
                   pack: bool = True):
     """``pack=True`` emits packed parity bytes [B, R, S] (the fused
     single-chip transform).  ``pack=False`` stops before the mod-2/pack
-    and emits the raw int32 popcount accumulator [B, R8, S] — the
-    per-chip half of the contraction-sharded (tp) mesh path: partial
-    popcounts from different chips *add* (GF(2^8) addition is XOR), so
-    the mesh layer can ``psum`` them over ICI and apply one mod-2/pack
-    after the collective (parallel/mesh.py)."""
+    and emits the raw popcount accumulator [B, R8, S] — the per-chip
+    half of the contraction-sharded (tp) mesh path: partial popcounts
+    from different chips *add* (GF(2^8) addition is XOR), so the mesh
+    layer can ``psum`` them over ICI and apply one mod-2/pack after the
+    collective (parallel/mesh.py).  The accumulator is int16: the MXU
+    still accumulates in exact int32, but the global popcount is at most
+    K8 <= 2048 ones, so narrowing before the HBM store halves both the
+    accumulator's HBM traffic and the ICI bytes the tp psum moves."""
     jax = _jx()
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -107,7 +110,7 @@ def _build_kernel(r: int, k: int, tile_s: int, bblock: int, interpret: bool,
                 preferred_element_type=jnp.int32,
             )  # [R8, TS]
             if not pack:
-                out_ref[bi] = acc
+                out_ref[bi] = acc.astype(jnp.int16)
                 continue
             acc = acc & 1
             packed = acc[0:r, :]
@@ -115,7 +118,7 @@ def _build_kernel(r: int, k: int, tile_s: int, bblock: int, interpret: bool,
                 packed = packed | (acc[b * r:(b + 1) * r, :] << b)
             out_ref[bi] = packed.astype(jnp.uint8)
 
-    out_rows, out_dtype = (r, jnp.uint8) if pack else (r8, jnp.int32)
+    out_rows, out_dtype = (r, jnp.uint8) if pack else (r8, jnp.int16)
 
     def call(m2, data):
         batch, _k, s = data.shape
@@ -141,8 +144,9 @@ def _pick_tile(s: int, k: int, row_bytes: int = 0) -> int:
     """Largest power-of-two tile dividing s, capped so the int8 bit-plane
     scratch (k*8 rows x tile lanes) stays within ~4 MiB of VMEM (s must be
     a multiple of 128 for the fast path; 32 KiB tiles measured fastest at
-    d=10).  ``row_bytes`` adds a per-lane VMEM cost for the output block
-    (the int32 accumulator of the acc kernel), capped at ~6 MiB."""
+    d=10).  ``row_bytes`` adds a per-lane VMEM cost for the acc kernel's
+    dot intermediate (int32, regardless of stored dtype), capped at
+    ~6 MiB."""
     tile = 32768
     while tile > 128 and tile * k * 8 > (4 << 20):
         tile //= 2
@@ -177,12 +181,15 @@ def apply_m2_bitmajor(m2, shards, *, interpret: bool = False):
 
 def acc_m2_bitmajor(m2, shards, *, interpret: bool = False):
     """Partial bit-plane accumulation (pre mod-2), bit-major rows:
-    int32 [B, R*8, S].  Per-chip half of the tp-sharded mesh encode."""
+    int16 [B, R*8, S] (exact — the global popcount is <= K8 <= 2048).
+    Per-chip half of the tp-sharded mesh encode."""
     r8, k8 = m2.shape
     r, k = r8 // 8, k8 // 8
     b, k2, s = shards.shape
     assert k2 == k
     bblock = 2 if b % 2 == 0 else 1
+    # budget at int32 cost: the dot intermediate is int32 in VMEM even
+    # though the stored accumulator is int16
     tile = _pick_tile(s, k, row_bytes=r8 * 4 * bblock)
     if tile == 0 or r == 0:
         raise ValueError(f"shard size {s} not tileable for pallas path")
@@ -191,15 +198,15 @@ def acc_m2_bitmajor(m2, shards, *, interpret: bool = False):
 
 
 def pack_acc_bitmajor(acc):
-    """Pack int32 bit-major popcounts [B, R*8, S] into bytes [B, R, S]:
-    row ``b*R + i`` is bit b of output byte-row i (the layout
-    ``bit_matrix_bitmajor`` produces), so the mod-2 bits of plane b land
-    at bit position b of byte i."""
+    """Pack bit-major popcounts [B, R*8, S] (any integer dtype) into
+    bytes [B, R, S]: row ``b*R + i`` is bit b of output byte-row i (the
+    layout ``bit_matrix_bitmajor`` produces), so the mod-2 bits of plane
+    b land at bit position b of byte i."""
     import jax.numpy as jnp
 
     b, r8, s = acc.shape
     r = r8 // 8
-    bits = (acc & 1).reshape(b, 8, r, s)
+    bits = (acc & 1).astype(jnp.int32).reshape(b, 8, r, s)
     shifts = jnp.arange(8, dtype=jnp.int32)
     return jnp.sum(bits << shifts[None, :, None, None],
                    axis=1).astype(jnp.uint8)

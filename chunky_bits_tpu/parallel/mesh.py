@@ -18,7 +18,9 @@ The reference scales with HTTP fan-out across storage nodes
   followed by a single mod-2 — exact because GF(2^8) addition is XOR and
   popcounts add over chips.  This is the tensor-parallel decomposition of
   erasure coding: the per-chip working set shrinks with the stripe width,
-  and the only cross-chip traffic is the [B, p*8, S] accumulator riding ICI.
+  and the only cross-chip traffic is the [B, p*8, S] accumulator riding
+  ICI (int16 on the pallas impl — exact, since the global popcount is at
+  most d*8 <= 2048 — halving the psum bytes).
 
 The bit-matrix is tiny (<=2048x2048 bits) and replicated (column-sharded
 over ``tp`` in the wide-stripe path).  Collectives are the ``tp`` psum and a

@@ -208,6 +208,40 @@ def test_sharded_apply_pallas_impl_identity(eight_devices):
     assert np.array_equal(got, full[:, np.array(erased), :])
 
 
+def test_acc_kernel_int16_contract():
+    """The pack-free accumulator narrows to int16 after the exact int32
+    MXU accumulation (global popcount <= K8 <= 2048): dtype is part of
+    the mesh contract — it halves the tp psum's ICI bytes — and the
+    post-psum bit-major pack must reproduce the oracle from it."""
+    import jax.numpy as jnp
+
+    from chunky_bits_tpu.ops.pallas_kernels import (
+        acc_m2_bitmajor,
+        bitmajor_device_matrix,
+        pack_acc_bitmajor,
+    )
+
+    d, p = 20, 6
+    enc = matrix.build_encode_matrix(d, p)
+    rng = np.random.default_rng(13)
+    data = rng.integers(0, 256, (2, d, 256), dtype=np.uint8)
+    m2 = bitmajor_device_matrix(enc[d:])
+    acc = acc_m2_bitmajor(m2, jnp.asarray(data), interpret=True)
+    assert acc.dtype == jnp.int16
+    want = ErasureCoder(d, p, NumpyBackend()).encode_batch(data)
+    assert np.array_equal(np.asarray(pack_acc_bitmajor(acc)), want)
+    # the contraction-split sum of two half-stripe accumulators equals
+    # the full accumulator (the psum identity, minus the mesh)
+    half = d // 2
+    m2a = bitmajor_device_matrix(np.ascontiguousarray(enc[d:, :half]))
+    m2b = bitmajor_device_matrix(np.ascontiguousarray(enc[d:, half:]))
+    acc2 = (acc_m2_bitmajor(m2a, jnp.asarray(data[:, :half]),
+                            interpret=True)
+            + acc_m2_bitmajor(m2b, jnp.asarray(data[:, half:]),
+                              interpret=True))
+    assert np.array_equal(np.asarray(pack_acc_bitmajor(acc2)), want)
+
+
 def test_mesh_auto_impl_einsum_on_cpu(eight_devices):
     """Virtual CPU meshes must keep auto-selecting the einsum impl (the
     pallas Mosaic kernel only compiles on TPU)."""
