@@ -9,12 +9,18 @@
 // chunky_bits_tpu/ops/gf256.py — the Python side cross-checks the tables at
 // load time, and tests cross-check SHA-256 against hashlib.
 //
-// The GF inner loop uses the classic nibble-table pshufb trick under AVX2
-// (c*x = T_c[x>>4 << 4] ^ T_c[x&15]) and falls back to full-table scalar
-// lookups elsewhere.  SHA-256 uses the SHA-NI extension when the CPU has it
-// (runtime dispatch) and a portable scalar path otherwise.  `cb_encode_hash`
-// fuses parity + per-shard hashing in one pass per batch item while the
-// shard bytes are cache-hot.  Batch items are fanned across std::threads.
+// The GF inner loop dispatches at runtime: on GFNI+AVX-512 hosts a single
+// gf2p8affineqb applies the 8x8 bit-matrix of "multiply by c" to 64 bytes
+// per instruction (the constant-multiplier map is GF(2)-linear, so it works
+// for the 0x11d field even though the ISA's gf2p8mulb is hardwired to the
+// AES polynomial); otherwise the classic nibble-table pshufb trick under
+// AVX2 (c*x = T_c[x>>4 << 4] ^ T_c[x&15]); full-table scalar elsewhere.
+// The GFNI path self-verifies against the scalar tables at startup and
+// disables itself on any mismatch.  SHA-256 uses the SHA-NI extension when
+// the CPU has it (runtime dispatch) and a portable scalar path otherwise.
+// `cb_encode_hash` fuses parity + per-shard hashing in one pass per batch
+// item while the shard bytes are cache-hot.  Batch items are fanned across
+// std::threads.
 
 #include <cstddef>
 #include <cstdint>
@@ -54,6 +60,80 @@ bool init_tables() {
 
 const bool kInited = init_tables();
 
+// ---- GFNI path: multiply-by-c as an 8x8 GF(2) affine transform ----
+//
+// gf2p8affineqb computes out_bit[i] = parity(A.byte[7-i] & x) per data
+// byte (empirically probed + verified on this convention), so the matrix
+// qword for constant c packs bit (7-k) of c*2^j at byte k, bit j.
+
+#if defined(__x86_64__)
+uint64_t GFNI_MAT[256];
+
+uint64_t gfni_matrix(uint8_t c) {
+    uint8_t col[8];
+    for (int j = 0; j < 8; j++) col[j] = MUL[c][1 << j];
+    uint64_t a = 0;
+    for (int k = 0; k < 8; k++) {
+        uint8_t row = 0;
+        for (int j = 0; j < 8; j++)
+            row |= static_cast<uint8_t>(((col[j] >> (7 - k)) & 1) << j);
+        a |= static_cast<uint64_t>(row) << (8 * k);
+    }
+    return a;
+}
+
+__attribute__((target("avx512f,avx512bw,avx512vl,gfni")))
+bool gfni_self_test() {
+    // spot-verify the instruction semantics against the scalar tables;
+    // a convention mismatch (or emulator quirk) disables the path
+    const uint8_t cs[] = {1, 2, 3, 0x1d, 0x53, 0x8e, 0xff};
+    for (uint8_t c : cs) {
+        __m128i a = _mm_set1_epi64x(
+            static_cast<long long>(GFNI_MAT[c]));
+        for (int x = 0; x < 256; x += 17) {
+            __m128i v = _mm_set1_epi8(static_cast<char>(x));
+            __m128i r = _mm_gf2p8affine_epi64_epi8(v, a, 0);
+            if (static_cast<uint8_t>(_mm_extract_epi8(r, 0))
+                    != MUL[c][x])
+                return false;
+        }
+    }
+    return true;
+}
+
+bool init_gfni() {
+    if (!(__builtin_cpu_supports("avx512f")
+          && __builtin_cpu_supports("avx512bw")
+          && __builtin_cpu_supports("avx512vl")
+          && __builtin_cpu_supports("gfni")))
+        return false;
+    for (int c = 0; c < 256; c++)
+        GFNI_MAT[c] = gfni_matrix(static_cast<uint8_t>(c));
+    return gfni_self_test();
+}
+
+const bool kGfni = init_gfni();
+
+__attribute__((target("avx512f,avx512bw,avx512vl,gfni")))
+size_t mul_row_xor_gfni(uint8_t c, const uint8_t* src, uint8_t* dst,
+                        size_t n) {
+    __m512i a = _mm512_set1_epi64(static_cast<long long>(GFNI_MAT[c]));
+    size_t i = 0;
+    for (; i + 64 <= n; i += 64) {
+        __m512i v = _mm512_loadu_si512(src + i);
+        __m512i r = _mm512_gf2p8affine_epi64_epi8(v, a, 0);
+        __m512i d = _mm512_loadu_si512(dst + i);
+        _mm512_storeu_si512(dst + i, _mm512_xor_si512(d, r));
+    }
+    return i;  // caller finishes the tail with the scalar table
+}
+#else
+const bool kGfni = false;
+size_t mul_row_xor_gfni(uint8_t, const uint8_t*, uint8_t*, size_t) {
+    return 0;
+}
+#endif
+
 void xor_row(const uint8_t* src, uint8_t* dst, size_t n) {
     size_t i = 0;
 #ifdef __AVX2__
@@ -72,6 +152,11 @@ void xor_row(const uint8_t* src, uint8_t* dst, size_t n) {
 void mul_row_xor(uint8_t c, const uint8_t* src, uint8_t* dst, size_t n) {
     const uint8_t* table = MUL[c];
     size_t i = 0;
+    if (kGfni) {
+        i = mul_row_xor_gfni(c, src, dst, n);
+        for (; i < n; i++) dst[i] ^= table[src[i]];
+        return;
+    }
 #ifdef __AVX2__
     alignas(16) uint8_t lo[16], hi[16];
     for (int v = 0; v < 16; v++) {
