@@ -185,19 +185,33 @@ void mul_row_xor(uint8_t c, const uint8_t* src, uint8_t* dst, size_t n) {
 }
 
 // One batch item: out[r, s] = mat[r, k] (x) shards[k, s] over GF(2^8).
+//
+// Blocked over the byte axis so each (r + k)-row working chunk stays in
+// L2 across the whole coefficient grid: without blocking, every output
+// row streams all k megabyte-scale input rows from DRAM again — r*k*3
+// row-passes of memory traffic vs (k reads + r writes) with blocking
+// (~9x less at d=10 p=4), which is what the byte-level kernels (GFNI /
+// pshufb) are fast enough to expose.
 void apply_one(const uint8_t* mat, size_t r, size_t k,
                const uint8_t* shards, size_t s, uint8_t* out) {
-    std::memset(out, 0, r * s);
-    for (size_t i = 0; i < r; i++) {
-        uint8_t* dst = out + i * s;
-        for (size_t j = 0; j < k; j++) {
-            uint8_t c = mat[i * k + j];
-            if (c == 0) continue;
-            const uint8_t* src = shards + j * s;
-            if (c == 1) {
-                xor_row(src, dst, s);
-            } else {
-                mul_row_xor(c, src, dst, s);
+    constexpr size_t BLK = 32768;  // (k + r) * BLK ~ 0.5-1 MiB << L2+L3
+    for (size_t off = 0; off < s; off += BLK) {
+        size_t len = s - off < BLK ? s - off : BLK;
+        for (size_t i = 0; i < r; i++) {
+            uint8_t* dst = out + i * s + off;
+            // zero here, not up front: a whole-buffer memset would
+            // stream r*s bytes through cache before any accumulation,
+            // evicting the very chunks the blocking keeps hot
+            std::memset(dst, 0, len);
+            for (size_t j = 0; j < k; j++) {
+                uint8_t c = mat[i * k + j];
+                if (c == 0) continue;
+                const uint8_t* src = shards + j * s + off;
+                if (c == 1) {
+                    xor_row(src, dst, len);
+                } else {
+                    mul_row_xor(c, src, dst, len);
+                }
             }
         }
     }
