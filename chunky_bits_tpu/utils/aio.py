@@ -195,29 +195,16 @@ class IterReader:
         return out
 
 
-async def read_exact_or_eof(reader: AsyncByteReader, n: int) -> bytes:
-    """Read exactly n bytes unless EOF comes first (the reference's
-    read-exact-but-handle-EOF loop, src/file/writer.rs:175-193)."""
-    chunks: list[bytes] = []
-    got = 0
-    while got < n:
-        data = await reader.read(n - got)
-        if not data:
-            break
-        chunks.append(data)
-        got += len(data)
-    return b"".join(chunks)
-
-
 async def read_exact_into(reader: AsyncByteReader, mem: memoryview) -> int:
     """Fill ``mem`` until full or EOF; returns bytes filled.
 
-    The zero-extra-copy variant of ``read_exact_or_eof`` for callers
-    that own a destination buffer (the writer's staging block): a
-    reader exposing ``async readinto(mem) -> int`` lands bytes directly
-    in place; otherwise each ``read()`` chunk is copied straight into
-    position — one pass either way, where read_exact_or_eof costs a
-    join pass plus the caller's restage pass."""
+    The reference's read-exact-but-handle-EOF loop
+    (src/file/writer.rs:175-193), zero-extra-copy: a reader exposing
+    ``async readinto(mem) -> int`` lands bytes directly in the caller's
+    buffer (the writer's staging block); otherwise each ``read()`` chunk
+    is copied straight into position — one pass either way, where a
+    read-then-join shape would cost a join pass plus the caller's
+    restage pass."""
     n = len(mem)
     got = 0
     readinto = getattr(reader, "readinto", None)
