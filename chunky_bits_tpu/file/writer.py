@@ -124,11 +124,15 @@ class FileWriteBuilder:
             # device backend with no shared batcher: coalesce this
             # write's own sub-blocks back into [<=batch_parts, d, S]
             # dispatches, so streamed staging doesn't shrink the device
-            # batches that amortize per-dispatch overhead
+            # batches that amortize per-dispatch overhead.  max_batch
+            # counts batcher REQUESTS — sub-blocks of up to stage_size
+            # parts each — so divide to keep the merged dispatch within
+            # batch_parts parts.
             from chunky_bits_tpu.ops.batching import EncodeHashBatcher
 
-            encode_batcher = EncodeHashBatcher(backend=self.backend,
-                                               max_batch=batch_parts)
+            encode_batcher = EncodeHashBatcher(
+                backend=self.backend,
+                max_batch=max(1, batch_parts // stage_size))
             own_batcher = True
 
         # Read-ahead bound: by default at most two sub-blocks of raw parts

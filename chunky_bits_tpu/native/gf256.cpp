@@ -66,7 +66,17 @@ const bool kInited = init_tables();
 // byte (empirically probed + verified on this convention), so the matrix
 // qword for constant c packs bit (7-k) of c*2^j at byte k, bit j.
 
-#if defined(__x86_64__)
+// Compiler gate, not just arch: __builtin_cpu_supports("gfni") only
+// exists from GCC 11 / clang 10 — on older toolchains the whole GFNI
+// block must vanish or the native build (and with it the default
+// backend) silently degrades to numpy.
+#if defined(__x86_64__) && \
+    ((defined(__clang__) && __clang_major__ >= 10) || \
+     (!defined(__clang__) && defined(__GNUC__) && __GNUC__ >= 11))
+#define CB_HAVE_GFNI 1
+#endif
+
+#ifdef CB_HAVE_GFNI
 uint64_t GFNI_MAT[256];
 
 uint64_t gfni_matrix(uint8_t c) {

@@ -292,7 +292,8 @@ def test_writer_owns_batcher_for_merging_backend(tmp_path):
                    .with_backend("numpy-merging"))
         ref = await builder.write(aio.BytesReader(payload))
         assert len(created) == 1, "writer should own exactly one batcher"
-        assert created[0].max_batch == 64
+        # max_batch counts sub-block requests: 64 parts / 4-part blocks
+        assert created[0].max_batch == 16
         # sub-blocks of 4 coalesced: far fewer dispatches than the 20
         # parts, and the content still reads back exactly
         assert created[0].dispatches < 20
