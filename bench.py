@@ -23,6 +23,27 @@ import numpy as np
 
 
 def main() -> None:
+    import os
+    import threading
+
+    # Device-init watchdog: the tunneled dev chip's PJRT client blocks
+    # indefinitely when the tunnel endpoint is down (observed round 4:
+    # multi-hour outage; even jax.devices() hangs).  Emit a parseable
+    # error line instead of hanging the driver.  600 s comfortably
+    # covers a cold first compile (~40 s).
+    ready = threading.Event()
+
+    def watchdog() -> None:
+        if not ready.wait(600):
+            print(json.dumps({
+                "metric": "rs_parity_encode_gibps",
+                "value": 0.0, "unit": "GiB/s", "vs_baseline": 0.0,
+                "error": "device init timeout (tpu tunnel unreachable)",
+            }), flush=True)
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+
     import jax
     import jax.numpy as jnp
 
@@ -33,6 +54,7 @@ def main() -> None:
     d, p = 10, 4
     size = 1 << 20  # 1 MiB chunks
     on_accel = jax.default_backend() != "cpu"
+    ready.set()  # backends initialized; the tunnel answered
     batch = 128 if on_accel else 4
     iters = 10 if on_accel else 2
 
