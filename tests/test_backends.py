@@ -92,3 +92,20 @@ def test_native_thread_knob_spec():
     for bad in ("native:", "native:0", "native:-2", "native:x"):
         with pytest.raises(ErasureError, match="thread count"):
             get_backend(bad)
+
+
+@pytest.mark.parametrize("s", [1, 31, 32, 33, 63, 64, 65, 127, 128, 129,
+                               4095, 4096, 4097])
+def test_native_vector_width_boundaries(s):
+    """Shard sizes straddling the SIMD vector widths (32 B AVX2, 64 B
+    GFNI/AVX-512) must agree with the oracle exactly — the kernels hand
+    their tails to the scalar table mid-row."""
+    try:
+        be = get_backend("native")
+    except Exception as err:  # pragma: no cover
+        pytest.skip(f"native backend unavailable: {err}")
+    d, p = 5, 3
+    rng = np.random.default_rng(s)
+    data = rng.integers(0, 256, (3, d, s), dtype=np.uint8)
+    want = ErasureCoder(d, p, NumpyBackend()).encode_batch(data)
+    assert np.array_equal(ErasureCoder(d, p, be).encode_batch(data), want)
