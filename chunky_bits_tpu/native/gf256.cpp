@@ -202,28 +202,38 @@ void mul_row_xor(uint8_t c, const uint8_t* src, uint8_t* dst, size_t n) {
 // row-passes of memory traffic vs (k reads + r writes) with blocking
 // (~9x less at d=10 p=4), which is what the byte-level kernels (GFNI /
 // pshufb) are fast enough to expose.
-void apply_one(const uint8_t* mat, size_t r, size_t k,
-               const uint8_t* shards, size_t s, uint8_t* out) {
-    constexpr size_t BLK = 32768;  // (k + r) * BLK ~ 0.5-1 MiB << L2+L3
-    for (size_t off = 0; off < s; off += BLK) {
-        size_t len = s - off < BLK ? s - off : BLK;
-        for (size_t i = 0; i < r; i++) {
-            uint8_t* dst = out + i * s + off;
-            // zero here, not up front: a whole-buffer memset would
-            // stream r*s bytes through cache before any accumulation,
-            // evicting the very chunks the blocking keeps hot
-            std::memset(dst, 0, len);
-            for (size_t j = 0; j < k; j++) {
-                uint8_t c = mat[i * k + j];
-                if (c == 0) continue;
-                const uint8_t* src = shards + j * s + off;
-                if (c == 1) {
-                    xor_row(src, dst, len);
-                } else {
-                    mul_row_xor(c, src, dst, len);
-                }
+//: byte-axis block size: (k + r) * BLK ~ 0.5-1 MiB << L2+L3, and a
+//: multiple of 64 so SHA block boundaries align (encode_hash_one)
+constexpr size_t kApplyBlk = 32768;
+
+// One byte-range [off, off+len) of the coefficient grid.
+void apply_block(const uint8_t* mat, size_t r, size_t k,
+                 const uint8_t* shards, size_t s, uint8_t* out,
+                 size_t off, size_t len) {
+    for (size_t i = 0; i < r; i++) {
+        uint8_t* dst = out + i * s + off;
+        // zero here, not up front: a whole-buffer memset would
+        // stream r*s bytes through cache before any accumulation,
+        // evicting the very chunks the blocking keeps hot
+        std::memset(dst, 0, len);
+        for (size_t j = 0; j < k; j++) {
+            uint8_t c = mat[i * k + j];
+            if (c == 0) continue;
+            const uint8_t* src = shards + j * s + off;
+            if (c == 1) {
+                xor_row(src, dst, len);
+            } else {
+                mul_row_xor(c, src, dst, len);
             }
         }
+    }
+}
+
+void apply_one(const uint8_t* mat, size_t r, size_t k,
+               const uint8_t* shards, size_t s, uint8_t* out) {
+    for (size_t off = 0; off < s; off += kApplyBlk) {
+        size_t len = s - off < kApplyBlk ? s - off : kApplyBlk;
+        apply_block(mat, r, k, shards, s, out, off, len);
     }
 }
 
@@ -559,6 +569,44 @@ int digest_file(const char* path, uint64_t start, uint64_t want,
 
 }  // namespace sha256
 
+// Fused encode+hash for one batch item, block-interleaved: each 32 KiB
+// byte range runs the GF coefficient grid and then immediately feeds the
+// (still L2-hot) data and fresh parity chunks into streaming SHA states
+// — every byte crosses DRAM once for both jobs, where the sequential
+// encode-then-hash shape re-reads all k+r rows for the hash pass.
+void encode_hash_one(const uint8_t* mat, size_t r, size_t k,
+                     const uint8_t* item, size_t s,
+                     uint8_t* parity, uint8_t* hashes) {
+    const size_t total = k + r;
+    std::vector<uint32_t> st(total * 8);
+    for (size_t j = 0; j < total; j++)
+        std::memcpy(&st[j * 8], sha256::H0, 32);
+    auto row = [&](size_t j) {
+        return j < k ? item + j * s : parity + (j - k) * s;
+    };
+    size_t hashed = 0;  // bytes per row consumed by whole SHA blocks
+    for (size_t off = 0; off < s; off += kApplyBlk) {
+        size_t len = s - off < kApplyBlk ? s - off : kApplyBlk;
+        if (r > 0) apply_block(mat, r, k, item, s, parity, off, len);
+        size_t blocks = len / 64;  // short only on the final range
+        if (blocks) {
+            size_t j = 0;
+            if (sha256::kTransform2 != nullptr) {
+                for (; j + 1 < total; j += 2)
+                    sha256::kTransform2(&st[j * 8], row(j) + off,
+                                        &st[(j + 1) * 8],
+                                        row(j + 1) + off, blocks);
+            }
+            for (; j < total; j++)
+                sha256::kTransform(&st[j * 8], row(j) + off, blocks);
+            hashed = off + blocks * 64;
+        }
+    }
+    for (size_t j = 0; j < total; j++)
+        sha256::finalize(&st[j * 8], row(j) + hashed, s - hashed,
+                         static_cast<uint64_t>(s), hashes + j * 32);
+}
+
 // Run `fn(i)` for i in [0, n) across up to `nthreads` std::threads
 // (<=0 => hardware concurrency).
 template <typename Fn>
@@ -644,23 +692,9 @@ void cb_encode_hash(const uint8_t* mat, size_t r, size_t k,
                     uint8_t* out_parity, uint8_t* out_hashes, int nthreads) {
     if (!kInited || b == 0 || s == 0) return;
     parallel_for(b, nthreads, [=](size_t i) {
-        const uint8_t* item = shards + i * k * s;
-        uint8_t* parity = out_parity + i * r * s;
-        uint8_t* hashes = out_hashes + i * (k + r) * 32;
-        if (r > 0) apply_one(mat, r, k, item, s, parity);
-        // All k+r shard rows are independent equal-length streams: hash
-        // them pairwise through the interleaved SHA-NI path.
-        auto row = [&](size_t j) {
-            return j < k ? item + j * s : parity + (j - k) * s;
-        };
-        size_t total = k + r;
-        for (size_t j = 0; j + 1 < total; j += 2) {
-            sha256::digest_pair(row(j), row(j + 1), s,
-                                hashes + j * 32, hashes + (j + 1) * 32);
-        }
-        if (total % 2) {
-            sha256::digest(row(total - 1), s, hashes + (total - 1) * 32);
-        }
+        encode_hash_one(mat, r, k, shards + i * k * s, s,
+                        out_parity + i * r * s,
+                        out_hashes + i * (k + r) * 32);
     });
 }
 

@@ -95,11 +95,16 @@ def test_native_thread_knob_spec():
 
 
 @pytest.mark.parametrize("s", [1, 31, 32, 33, 63, 64, 65, 127, 128, 129,
-                               4095, 4096, 4097])
+                               4095, 4096, 4097, 32768, 32769, 70000])
 def test_native_vector_width_boundaries(s):
     """Shard sizes straddling the SIMD vector widths (32 B AVX2, 64 B
-    GFNI/AVX-512) must agree with the oracle exactly — the kernels hand
-    their tails to the scalar table mid-row."""
+    GFNI/AVX-512 and the SHA block) and the 32 KiB fusion block must
+    agree with the oracles exactly, on both the pure encode path and
+    the block-interleaved encode+hash path (streaming SHA cursor:
+    sub-64-byte tails, multi-range accumulation, blockless final
+    range)."""
+    import hashlib
+
     try:
         be = get_backend("native")
     except Exception as err:  # pragma: no cover
@@ -108,4 +113,14 @@ def test_native_vector_width_boundaries(s):
     rng = np.random.default_rng(s)
     data = rng.integers(0, 256, (3, d, s), dtype=np.uint8)
     want = ErasureCoder(d, p, NumpyBackend()).encode_batch(data)
-    assert np.array_equal(ErasureCoder(d, p, be).encode_batch(data), want)
+    coder = ErasureCoder(d, p, be)
+    assert np.array_equal(coder.encode_batch(data), want)
+    parity, digests = coder.encode_hash_batch(data)
+    assert np.array_equal(parity, want)
+    for bi in range(data.shape[0]):
+        for j in range(d):
+            assert digests[bi, j].tobytes() == \
+                hashlib.sha256(data[bi, j]).digest(), (bi, j)
+        for j in range(p):
+            assert digests[bi, d + j].tobytes() == \
+                hashlib.sha256(want[bi, j]).digest(), (bi, j)
