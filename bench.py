@@ -22,27 +22,48 @@ import time
 import numpy as np
 
 
-def main() -> None:
+def _device_init_watchdog(metric: str):
+    """Device-init watchdog: the tunneled dev chip's PJRT client blocks
+    indefinitely when the tunnel endpoint is down (observed round 4:
+    multi-hour outage; even jax.devices() hangs).  Emit a parseable
+    error line instead of hanging the driver.  600 s comfortably covers
+    a cold first compile (~40 s).  Returns the Event the caller must
+    ``set()`` once the device has answered (first compile/dispatch
+    done); every bench path that can touch a device must arm this."""
     import os
     import threading
 
-    # Device-init watchdog: the tunneled dev chip's PJRT client blocks
-    # indefinitely when the tunnel endpoint is down (observed round 4:
-    # multi-hour outage; even jax.devices() hangs).  Emit a parseable
-    # error line instead of hanging the driver.  600 s comfortably
-    # covers a cold first compile (~40 s).
     ready = threading.Event()
 
     def watchdog() -> None:
         if not ready.wait(600):
             print(json.dumps({
-                "metric": "rs_parity_encode_gibps",
+                "metric": metric,
                 "value": 0.0, "unit": "GiB/s", "vs_baseline": 0.0,
                 "error": "device init timeout (tpu tunnel unreachable)",
             }), flush=True)
             os._exit(3)
 
     threading.Thread(target=watchdog, daemon=True).start()
+    return ready
+
+
+def _arm_if_device_backend(backend, metric: str):
+    """Arm the init watchdog when the effective backend spec resolves to
+    a device backend ("jax"/"jax:...", explicitly or via
+    $CHUNKY_BITS_TPU_BACKEND) — those are the paths that can block
+    forever in PJRT init.  Returns the armed Event, or None (CPU
+    backends can't hang on device init)."""
+    import os
+
+    effective = backend or os.environ.get("CHUNKY_BITS_TPU_BACKEND") or ""
+    if effective.split(":", 1)[0] != "jax":
+        return None
+    return _device_init_watchdog(metric)
+
+
+def main() -> None:
+    ready = _device_init_watchdog("rs_parity_encode_gibps")
 
     import jax
     import jax.numpy as jnp
@@ -277,38 +298,16 @@ def bench_cp_pipeline(argv: list) -> None:
 
     class NoHashBatcher(EncodeHashBatcher):
         """Parity on the device, zero digests: isolates the pipeline
-        from the 1-core host SHA bound.  Mirrors the parent's merge
-        policy (merge only for merge-preferring device backends) so the
-        pipeline structure and dispatch counts stay comparable to the
-        hash-on run."""
+        from the 1-core host SHA bound.  Only the per-dispatch codec
+        call is replaced, so the parent's merge policy and dispatch
+        counting stay byte-for-byte comparable to the hash-on run."""
 
-        def _run_group(self, key, batches):
-            from chunky_bits_tpu.ops.backend import get_coder
-
-            dd, pp, _size = key
-            coder = get_coder(dd, pp, self.backend)
-
-            def zero_digests(stacked):
-                return np.zeros((stacked.shape[0], dd + pp, 32),
-                                dtype=np.uint8)
-
-            merge = getattr(coder.backend, "prefers_merged_batches",
-                            False)
-            if not merge or len(batches) == 1:
-                self.dispatches += len(batches)
-                return [(coder.encode_batch(b), zero_digests(b))
-                        for b in batches]
-            self.dispatches += 1
-            merged = np.concatenate(batches, axis=0)
-            parity = coder.encode_batch(merged)
-            digests = zero_digests(merged)
-            out = []
-            lo = 0
-            for stacked in batches:
-                hi = lo + stacked.shape[0]
-                out.append((parity[lo:hi], digests[lo:hi]))
-                lo = hi
-            return out
+        def _encode(self, coder, stacked):
+            parity = coder.encode_batch(stacked)
+            digests = np.zeros(
+                (stacked.shape[0], coder.data + coder.parity, 32),
+                dtype=np.uint8)
+            return parity, digests
 
     batcher_cls = NoHashBatcher if no_hash else EncodeHashBatcher
     batcher_box = {}
@@ -316,6 +315,10 @@ def bench_cp_pipeline(argv: list) -> None:
     def make_batcher():
         batcher_box["b"] = batcher_cls(backend=backend, max_batch=batch)
         return batcher_box["b"]
+
+    ready = _arm_if_device_backend(
+        backend, "cp_pipeline_encode_gibps_d10p4_1mib_b" + str(batch)
+        + ("_nohash" if no_hash else ""))
 
     async def run() -> tuple:
         builder = (FileWriteBuilder()
@@ -330,6 +333,8 @@ def bench_cp_pipeline(argv: list) -> None:
         # warm (compile, thread pools) with one small batch
         await (builder.with_batch_parts(2).with_concurrency(6)
                .write(CyclicReader(2 * part_bytes)))
+        if ready is not None:
+            ready.set()  # device answered the warm-up dispatch
         t0 = time.perf_counter()
         ref = await builder.write(CyclicReader(total))
         dt = time.perf_counter() - t0
@@ -367,6 +372,10 @@ def bench_batched_repair() -> None:
     from chunky_bits_tpu.ops.batching import ReconstructBatcher
 
     d, p, size = 10, 4, 1 << 20
+    # armed before the prep encodes below — they hit the device too when
+    # $CHUNKY_BITS_TPU_BACKEND selects a jax backend
+    ready = _arm_if_device_backend(
+        None, "batched_repair_reconstruct_gibps_d10p4_4erasures")
     n_parts = 40
     rng = np.random.default_rng(0)
     coder = ErasureCoder(d, p, get_backend())
@@ -389,6 +398,8 @@ def bench_batched_repair() -> None:
                 return await batcher.reconstruct(d, p, list(rows))
 
         await one(parts[0])  # warm
+        if ready is not None:
+            ready.set()  # device answered the warm-up dispatch
         t0 = time.perf_counter()
         await asyncio.gather(*[one(r) for r in parts[1:]])
         dt = time.perf_counter() - t0
@@ -432,6 +443,8 @@ def bench_small_objects(argv=()) -> None:
     rng = np.random.default_rng(0)
     objs = [rng.integers(0, 256, (1, d, size), dtype=np.uint8)
             for _ in range(n_objects)]
+    ready = _arm_if_device_backend(
+        backend, "bulk_ingest_encode_hash_gibps_d8p3_4mib_objs")
 
     async def run() -> float:
         batcher = EncodeHashBatcher(backend=backend)
@@ -442,6 +455,8 @@ def bench_small_objects(argv=()) -> None:
                 await batcher.encode_hash(d, p, stacked)
 
         await one(objs[0])  # warm
+        if ready is not None:
+            ready.set()  # device answered the warm-up dispatch
         t0 = time.perf_counter()
         await asyncio.gather(*[one(o) for o in objs[1:]])
         dt = time.perf_counter() - t0

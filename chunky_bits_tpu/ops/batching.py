@@ -202,6 +202,13 @@ class EncodeHashBatcher(_CoalescingBatcher):
         key = (d, p, size)
         return await self._submit(key, stacked)
 
+    def _encode(self, coder, stacked: np.ndarray):
+        """The per-dispatch codec call: ``(parity, digests)`` for one
+        (possibly merged) ``[B, d, S]`` batch.  The merge policy, dispatch
+        counting, and slice-back in ``_run_group`` are shared — variants
+        (e.g. bench.py's hash-free pipeline probe) override only this."""
+        return coder.encode_hash_batch(stacked)
+
     def _run_group(self, key: tuple, batches: list[np.ndarray]) -> list:
         d, p, _size = key
         coder = get_coder(d, p, self.backend)
@@ -214,10 +221,10 @@ class EncodeHashBatcher(_CoalescingBatcher):
         merge = getattr(coder.backend, "prefers_merged_batches", False)
         if not merge or len(batches) == 1:
             self.dispatches += len(batches)
-            return [coder.encode_hash_batch(b) for b in batches]
+            return [self._encode(coder, b) for b in batches]
         self.dispatches += 1
         merged = np.concatenate(batches, axis=0)
-        parity, digests = coder.encode_hash_batch(merged)
+        parity, digests = self._encode(coder, merged)
         out = []
         lo = 0
         for batch in batches:
