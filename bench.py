@@ -257,6 +257,11 @@ def bench_cp_pipeline(argv: list) -> None:
     batch = flag("--batch", 256, int)
     stage = flag("--stage", 8, int)
     no_hash = "--no-hash" in argv
+    # --src file: materialize the stream to a temp file and ingest via
+    # aio.FileReader — engages the writer's zero-copy mmap view path,
+    # i.e. the real `cp local-file cluster#x` shape.  Default "cyclic"
+    # streams synthetic bytes through readinto (socket/pipe shape).
+    src = flag("--src", "cyclic", str)
 
     d, p, chunk = 10, 4, 1 << 20
     part_bytes = d * chunk
@@ -318,7 +323,8 @@ def bench_cp_pipeline(argv: list) -> None:
 
     ready = _arm_if_device_backend(
         backend, "cp_pipeline_encode_gibps_d10p4_1mib_b" + str(batch)
-        + ("_nohash" if no_hash else ""))
+        + ("_nohash" if no_hash else "")
+        + ("_mmap" if src == "file" else ""))
 
     async def run() -> tuple:
         builder = (FileWriteBuilder()
@@ -336,25 +342,52 @@ def bench_cp_pipeline(argv: list) -> None:
         if ready is not None:
             ready.set()  # device answered the warm-up dispatch
         t0 = time.perf_counter()
-        ref = await builder.write(CyclicReader(total))
+        ref = await builder.write(make_reader())
         dt = time.perf_counter() - t0
         # each write() resolves a fresh batcher, so the box holds the
         # measured run's instance and its count is exact
         return ref, dt, batcher_box["b"].dispatches
 
-    ref, dt, dispatches = asyncio.run(run())
+    import contextlib
+    import tempfile
+
+    with contextlib.ExitStack() as stack:
+        if src == "file":
+            from chunky_bits_tpu.utils import aio
+
+            tmp = stack.enter_context(
+                tempfile.NamedTemporaryFile(suffix=".cb-bench"))
+            written = 0
+            while written < total:
+                n = min(len(blob), total - written)
+                tmp.write(blob[:n])
+                written += n
+            tmp.flush()
+
+            def make_reader():
+                return aio.FileReader(tmp.name)
+        elif src == "cyclic":
+            def make_reader():
+                return CyclicReader(total)
+        else:
+            print(f"usage: bench.py --config 2 --src {{cyclic,file}} "
+                  f"(got {src!r})", file=sys.stderr)
+            sys.exit(2)
+        ref, dt, dispatches = asyncio.run(run())
     n_parts = len(ref.parts)
     assert n_parts == total // part_bytes
     gibps = total / dt / (1 << 30)
     per_dispatch = n_parts / max(dispatches, 1)
     print(f"# config 2: {total / (1 << 30):.1f} GiB through "
           f"FileWriteBuilder, backend={backend}, batch={batch}, "
-          f"hash={'off' if no_hash else 'on'}; {n_parts} parts in "
-          f"{dispatches} dispatches ({per_dispatch:.1f} parts/dispatch)",
+          f"src={src}, hash={'off' if no_hash else 'on'}; {n_parts} "
+          f"parts in {dispatches} dispatches "
+          f"({per_dispatch:.1f} parts/dispatch)",
           file=sys.stderr)
     print(json.dumps({
         "metric": "cp_pipeline_encode_gibps_d10p4_1mib_b" + str(batch)
-                  + ("_nohash" if no_hash else ""),
+                  + ("_nohash" if no_hash else "")
+                  + ("_mmap" if src == "file" else ""),
         "value": round(gibps, 2), "unit": "GiB/s",
         "vs_baseline": round(gibps / 5.0, 2),
         "parts_per_dispatch": round(per_dispatch, 1),

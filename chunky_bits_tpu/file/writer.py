@@ -290,10 +290,33 @@ class FileWriteBuilder:
                 t.cancel()
             await asyncio.gather(*batch_tasks, return_exceptions=True)
 
+        # Zero-copy source path: a reader exposing ``view_parts`` (local
+        # regular files, utils/aio.py) serves whole staging blocks as
+        # read-only page-cache views — full-length parts reach the
+        # encoder and the shard writers with no source memcpy at all.
+        # The tail (< one part) falls through to the readinto path.
+        view_parts = getattr(reader, "view_parts", None)
+
         try:
             while True:
                 await sem.acquire()
                 await encode_ahead.acquire()
+                if view_parts is not None and block is None:
+                    mv = await view_parts(part_bytes, stage_size)
+                    if mv is None:
+                        view_parts = None  # tail/unmappable: byte path
+                    else:
+                        blk = np.frombuffer(mv, dtype=np.uint8
+                                            ).reshape(-1, d, chunk)
+                        # permits for the parts beyond the first
+                        for _ in range(blk.shape[0] - 1):
+                            await sem.acquire()
+                            await encode_ahead.acquire()
+                        total_bytes += blk.shape[0] * part_bytes
+                        block, lens = blk, [part_bytes] * blk.shape[0]
+                        flush()
+                        check_failed()
+                        continue
                 if block is None:
                     block = np.empty((stage_size, d, chunk),
                                      dtype=np.uint8)

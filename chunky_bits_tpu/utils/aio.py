@@ -37,13 +37,21 @@ class BytesReader:
 
 
 class FileReader:
-    """Thread-offloaded file reader (the spawn_blocking analogue)."""
+    """Thread-offloaded file reader (the spawn_blocking analogue).
+
+    Regular files additionally expose ``view_parts``: zero-copy
+    page-cache views for the ingest staging path (writer.py), so a local
+    ``cp`` source skips the read() memcpy entirely — the erasure coder
+    and the shard hasher consume the mapped bytes in place."""
+
+    _NO_MAP = object()  # sentinel: mapping attempted and unavailable
 
     def __init__(self, path: str, offset: int = 0,
                  fileobj: Optional[io.BufferedReader] = None):
         self._path = path
         self._f = fileobj
         self._offset = offset
+        self._mm = None  # lazy mmap; _NO_MAP when the source can't map
 
     async def _ensure(self) -> io.BufferedReader:
         if self._f is None:
@@ -61,7 +69,59 @@ class FileReader:
         f = await self._ensure()
         return await asyncio.to_thread(f.readinto, mem)
 
+    def _view_parts_sync(self, f, part_bytes: int, max_parts: int):
+        if self._mm is None:
+            import mmap
+
+            if os.environ.get("CHUNKY_BITS_TPU_NO_MMAP"):
+                # opt-out for sources that may be truncated concurrently
+                # (see view_parts docstring)
+                self._mm = self._NO_MAP
+                return None
+            try:
+                self._mm = mmap.mmap(f.fileno(), 0,
+                                     access=mmap.ACCESS_READ)
+            except (ValueError, OSError, io.UnsupportedOperation,
+                    AttributeError):
+                # empty file, pipe/char device, or no fileno
+                self._mm = self._NO_MAP
+        if self._mm is self._NO_MAP:
+            return None
+        pos = f.tell()
+        k = min(max_parts, (len(self._mm) - pos) // part_bytes)
+        if k <= 0:
+            return None
+        f.seek(pos + k * part_bytes)
+        return memoryview(self._mm)[pos:pos + k * part_bytes]
+
+    async def view_parts(self, part_bytes: int,
+                         max_parts: int) -> Optional[memoryview]:
+        """Zero-copy staging view of the next k = min(``max_parts``,
+        full parts remaining) * ``part_bytes`` bytes, advancing the
+        stream position past them; ``None`` when no full part remains
+        (tail bytes flow through read()/readinto()) or the source isn't
+        mappable.  The view aliases the page cache via a lazily-created
+        private read-only mmap and stays valid for the reader's
+        lifetime (numpy consumers hold a buffer reference, so even a
+        GC'd reader keeps the pages alive).
+
+        Caveat (the usual mmap tradeoff, same as git's pack access): if
+        another process truncates the file mid-ingest, touching a mapped
+        page past the new EOF raises SIGBUS instead of the copy path's
+        graceful short read.  Sources subject to concurrent truncation
+        should set ``CHUNKY_BITS_TPU_NO_MMAP=1``, which keeps every part
+        on the readinto path."""
+        f = await self._ensure()
+        return await asyncio.to_thread(
+            self._view_parts_sync, f, part_bytes, max_parts)
+
     async def close(self) -> None:
+        if self._mm is not None and self._mm is not self._NO_MAP:
+            try:
+                self._mm.close()
+            except BufferError:
+                pass  # exported views outlive us; GC reclaims the map
+            self._mm = None
         if self._f is not None:
             await asyncio.to_thread(self._f.close)
             self._f = None

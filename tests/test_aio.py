@@ -103,3 +103,74 @@ def test_file_reader_readinto(tmp_path):
         await r.close()
 
     asyncio.run(main())
+
+
+def test_file_reader_view_parts(tmp_path):
+    """Zero-copy staging views: whole parts served as mmap views that
+    advance the stream position, interleaving cleanly with readinto for
+    the tail."""
+    part = 96
+    data = bytes(range(256)) * 2  # 512 bytes = 5 parts + 32-byte tail
+
+    async def main():
+        path = tmp_path / "f.bin"
+        path.write_bytes(data)
+        r = aio.FileReader(str(path))
+        mv = await r.view_parts(part, 3)
+        assert mv is not None and len(mv) == 3 * part
+        assert bytes(mv) == data[:3 * part]
+        # view is zero-copy: frombuffer aliases the page cache
+        arr = np.frombuffer(mv, dtype=np.uint8)
+        assert not arr.flags.writeable
+        mv2 = await r.view_parts(part, 3)
+        assert len(mv2) == 2 * part  # only 2 full parts remain
+        assert bytes(mv2) == data[3 * part:5 * part]
+        assert await r.view_parts(part, 3) is None  # tail < one part
+        buf = np.zeros(64, dtype=np.uint8)
+        got = await aio.read_exact_into(r, memoryview(buf))
+        assert got == 32  # the tail, exactly where the views left off
+        assert buf[:32].tobytes() == data[5 * part:]
+        await r.close()
+
+    asyncio.run(main())
+
+
+def test_file_reader_view_parts_offset_and_unmappable(tmp_path):
+    async def main():
+        data = bytes(range(256))
+        path = tmp_path / "f.bin"
+        path.write_bytes(data)
+        # seeked reader: views start at the offset
+        r = aio.FileReader(str(path), offset=16)
+        mv = await r.view_parts(80, 8)  # 240 bytes remain = 3 full parts
+        assert len(mv) == 240 and bytes(mv) == data[16:]
+        await r.close()
+        # empty file can't mmap: view path declines, byte path sees EOF
+        empty = tmp_path / "empty.bin"
+        empty.write_bytes(b"")
+        r = aio.FileReader(str(empty))
+        assert await r.view_parts(64, 4) is None
+        assert await r.read(10) == b""
+        await r.close()
+
+    asyncio.run(main())
+
+
+def test_view_parts_opt_out(tmp_path, monkeypatch):
+    """CHUNKY_BITS_TPU_NO_MMAP=1 keeps every part on the readinto copy
+    path (for sources subject to concurrent truncation)."""
+    monkeypatch.setenv("CHUNKY_BITS_TPU_NO_MMAP", "1")
+
+    async def main():
+        data = bytes(range(256))
+        path = tmp_path / "f.bin"
+        path.write_bytes(data)
+        r = aio.FileReader(str(path))
+        assert await r.view_parts(64, 2) is None
+        assert r._mm is aio.FileReader._NO_MAP
+        buf = np.zeros(256, dtype=np.uint8)
+        assert await aio.read_exact_into(r, memoryview(buf)) == 256
+        assert buf.tobytes() == data
+        await r.close()
+
+    asyncio.run(main())

@@ -439,3 +439,54 @@ def test_verify_fanout_is_bounded(tmp_path, monkeypatch):
         assert peaks["reads"] <= 10 * FilePart.VERIFY_READ_CONCURRENCY
 
     asyncio.run(main())
+
+
+@pytest.mark.parametrize("tail", [0, 500, 3 * 1024 - 1])
+def test_mmap_source_roundtrip(tmp_path, tail, monkeypatch):
+    monkeypatch.delenv("CHUNKY_BITS_TPU_NO_MMAP", raising=False)
+    """A local-file source engages the writer's zero-copy view path
+    (aio.FileReader.view_parts): full parts are encoded straight from
+    page-cache views with no source memcpy.  The resulting reference
+    must be byte-identical (every chunk hash) to the BytesReader copy
+    path's, across exact-multiple, short-tail, and near-full-tail
+    sizes."""
+    d, p, chunk = 3, 2, 1024
+    n_full = 9
+    payload = synthetic_bytes(d * chunk * n_full + tail, seed=61)
+    src = tmp_path / "src.bin"
+    src.write_bytes(payload)
+    dirs = []
+    for i in range(5):
+        dd = tmp_path / f"disk{i}"
+        dd.mkdir()
+        dirs.append(Location.parse(str(dd)))
+
+    async def main():
+        builder = (FileWriteBuilder()
+                   .with_destination(LocationsDestination(dirs))
+                   .with_chunk_size(chunk)
+                   .with_data_chunks(d)
+                   .with_parity_chunks(p)
+                   .with_batch_parts(8)
+                   .with_stage_parts(4)
+                   .with_concurrency(12))
+        reader = aio.FileReader(str(src))
+        ref = await builder.write(reader)
+        # the mmap path actually engaged (white-box: a real map was
+        # created, not the _NO_MAP "mapping unavailable" sentinel)
+        assert reader._mm is not None
+        assert reader._mm is not aio.FileReader._NO_MAP
+        await reader.close()
+        assert ref.length == len(payload)
+        got = await FileReadBuilder(ref).read_all()
+        assert got == payload
+        plain = await (FileWriteBuilder()
+                       .with_destination(LocationsDestination(dirs))
+                       .with_chunk_size(chunk)
+                       .with_data_chunks(d)
+                       .with_parity_chunks(p)
+                       .write(aio.BytesReader(payload)))
+        assert [c.hash for part in ref.parts for c in part.all_chunks()] \
+            == [c.hash for part in plain.parts for c in part.all_chunks()]
+
+    asyncio.run(main())
