@@ -490,3 +490,38 @@ def test_mmap_source_roundtrip(tmp_path, tail, monkeypatch):
             == [c.hash for part in plain.parts for c in part.all_chunks()]
 
     asyncio.run(main())
+
+
+@pytest.mark.parametrize("backend", ["jax", "jax:dp2,sp2"])
+def test_mmap_source_device_backend_identity(tmp_path, backend, monkeypatch):
+    """The read-only page-cache views flow through the device backends
+    (plain jax and mesh-sharded) unchanged: device_put accepts
+    non-writable arrays, and the resulting chunk hashes are identical to
+    the copy path's."""
+    monkeypatch.delenv("CHUNKY_BITS_TPU_NO_MMAP", raising=False)
+    pytest.importorskip("jax")
+    d, p, chunk = 3, 2, 1024
+    payload = synthetic_bytes(d * chunk * 6 + 500, seed=67)
+    src = tmp_path / "src.bin"
+    src.write_bytes(payload)
+
+    async def main():
+        builder = (FileWriteBuilder()
+                   .with_destination(None)
+                   .with_chunk_size(chunk)
+                   .with_data_chunks(d)
+                   .with_parity_chunks(p)
+                   .with_batch_parts(4)
+                   .with_stage_parts(2)
+                   .with_concurrency(8)
+                   .with_backend(backend))
+        reader = aio.FileReader(str(src))
+        ref = await builder.write(reader)
+        assert reader._mm is not None
+        assert reader._mm is not aio.FileReader._NO_MAP
+        await reader.close()
+        plain = await builder.write(aio.BytesReader(payload))
+        assert [c.hash for part in ref.parts for c in part.all_chunks()] \
+            == [c.hash for part in plain.parts for c in part.all_chunks()]
+
+    asyncio.run(main())
