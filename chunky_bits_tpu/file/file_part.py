@@ -106,6 +106,17 @@ async def _hash_local_fused(chunk, location, cx):
         return None
 
 
+async def _read_chunk_payload(location, cx):
+    """Chunk bytes for the read/resilver paths: a zero-copy page-cache
+    view for local chunks (``Location.read_view`` — hash verification,
+    RS reconstruction, and shard re-writes all consume buffers), else
+    the generic read."""
+    view = await location.read_view(cx)
+    if view is not None:
+        return view
+    return await location.read(cx)
+
+
 async def _reconstruct(arrays, d: int, p: int,
                        coder: Optional[ErasureCoder], backend: Optional[str],
                        batcher, data_only: bool):
@@ -198,7 +209,7 @@ class FilePart:
                     index, chunk = pool.pop(idx)
                 for location in chunk.locations:
                     try:
-                        data = await location.read(cx)
+                        data = await _read_chunk_payload(location, cx)
                     except LocationError:
                         continue
                     if await chunk.hash.verify_async(data):
@@ -363,7 +374,7 @@ class FilePart:
             chunk_bytes = None
             for location in chunk.locations:
                 try:
-                    data = await location.read(cx)
+                    data = await _read_chunk_payload(location, cx)
                 except LocationError as err:
                     report.append((None, str(err)))
                     continue

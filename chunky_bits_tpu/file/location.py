@@ -16,6 +16,7 @@ reads Content-Length from a HEAD response.
 from __future__ import annotations
 
 import asyncio
+import io
 import os
 import time
 from dataclasses import dataclass, field, replace
@@ -132,6 +133,78 @@ _DEFAULT_CONTEXT = LocationContext()
 
 def default_context() -> LocationContext:
     return _DEFAULT_CONTEXT
+
+
+def _atomic_publish(target: str, data) -> None:
+    """Local whole-buffer write, published atomically where possible.
+
+    Regular-file targets are written to a sibling temp file and
+    os.replace()d in, so a concurrent reader (including page-cache views
+    from ``read_view``) never observes a torn or in-place-truncated
+    file — the reference's direct open-truncate-write
+    (src/file/location.rs:219-236) has that window.  Crash durability
+    follows the filesystem's rename semantics (flush, no fsync —
+    matching the reference's flush-only behavior): after power loss the
+    path holds the old content, the new content, or on some filesystems
+    an empty file, but never a torn mix.  Symlinks (write through,
+    preserving the link) and special targets (devices, fifos — rename
+    would replace the node) keep the direct write.  An existing regular
+    file's permission bits carry over to the replacement; hard links
+    detach — correct for content-addressed chunks, where an in-place
+    rewrite would mutate every linked path."""
+    if os.path.islink(target) or (
+            os.path.exists(target) and not os.path.isfile(target)):
+        with open(target, "wb") as f:
+            f.write(data)
+            f.flush()
+        return
+    mode = None
+    try:
+        mode = os.stat(target).st_mode & 0o7777
+    except OSError:
+        pass
+    tmp = f"{target}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+        if mode is not None:
+            os.chmod(tmp, mode)
+        os.replace(tmp, target)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+async def _atomic_publish_stream(reader, target: str) -> int:
+    """Streaming variant of ``_atomic_publish`` (same target rules):
+    the stream lands in a sibling temp file and is renamed in, so
+    readers never see a partially-written file and a failed stream
+    leaves the previous content intact."""
+    if os.path.islink(target) or (
+            os.path.exists(target) and not os.path.isfile(target)):
+        return await aio.copy_reader_to_file(reader, target)
+    mode = None
+    try:
+        mode = os.stat(target).st_mode & 0o7777
+    except OSError:
+        pass
+    tmp = f"{target}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
+    try:
+        total = await aio.copy_reader_to_file(reader, tmp)
+        if mode is not None:
+            os.chmod(tmp, mode)
+        os.replace(tmp, target)
+        return total
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 class _HttpBodyReader:
@@ -422,6 +495,51 @@ class Location:
             cx.profiler.log_read(True, None, self, len(out), start)
         return out
 
+    async def read_view(self, cx: Optional[LocationContext] = None
+                        ) -> Optional[memoryview]:
+        """Zero-copy page-cache view of a local (optionally ranged)
+        file, or ``None`` when the fast path doesn't apply — non-local
+        targets, an active profiler (which must see the generic read),
+        ``CHUNKY_BITS_TPU_NO_MMAP=1``, ranges reaching past EOF (the
+        generic path owns short-read/extend-zeros semantics), or
+        unmappable files.
+
+        The read-only view keeps its backing map alive for its own
+        lifetime.  Chunk files are published atomically (``write`` and
+        streaming local writes replace via rename, never truncating a
+        regular file in place), so a concurrent re-write of the same
+        location can never invalidate a view already taken — the old
+        inode stays mapped.  A file truncated by an *external* writer
+        can still SIGBUS a held view; set ``CHUNKY_BITS_TPU_NO_MMAP=1``
+        for clusters whose storage is shared with such writers."""
+        cx = cx or default_context()
+        if (not self.is_local() or cx.profiler is not None
+                or os.environ.get("CHUNKY_BITS_TPU_NO_MMAP")):
+            return None
+        rng = self.range
+
+        def _map() -> Optional[memoryview]:
+            import mmap
+
+            try:
+                with open(self.target, "rb") as f:
+                    mm = mmap.mmap(f.fileno(), 0,
+                                   access=mmap.ACCESS_READ)
+            except (OSError, ValueError, io.UnsupportedOperation):
+                return None
+            start = rng.start or 0
+            if start < 0 or (rng.length is not None and rng.length < 0):
+                # negative ranges: the generic path owns the error
+                # (Python slicing would silently serve bytes from EOF)
+                return None
+            end = len(mm) if rng.length is None else start + rng.length
+            if end > len(mm) or start > len(mm):
+                # short range / zero-extension: generic path semantics
+                return None
+            return memoryview(mm)[start:end]
+
+        return await asyncio.to_thread(_map)
+
     # ---- write path ----
 
     async def write(self, data: bytes,
@@ -438,12 +556,9 @@ class Location:
                     cx.profiler.log_write(True, None, self, len(data), start)
                 return
             if self.is_local():
-                def _write() -> None:
-                    with open(self.target, "wb") as f:
-                        f.write(data)
-                        f.flush()
                 try:
-                    await asyncio.to_thread(_write)
+                    await asyncio.to_thread(_atomic_publish, self.target,
+                                            data)
                 except OSError as err:
                     raise LocationError(str(err)) from err
             else:
@@ -494,7 +609,7 @@ class Location:
             return 0
         if self.is_local():
             try:
-                return await aio.copy_reader_to_file(reader, self.target)
+                return await _atomic_publish_stream(reader, self.target)
             except OSError as err:
                 raise LocationError(str(err)) from err
         self._check_scheme(cx)

@@ -398,3 +398,109 @@ def test_plain_context_follows_redirects():
             await node.stop()
 
     asyncio.run(main())
+
+
+def test_read_view_semantics(tmp_path, monkeypatch):
+    """read_view serves zero-copy page-cache views for local (ranged)
+    reads inside the file, and declines exactly where the generic path
+    owns the semantics (past-EOF ranges, profiler, opt-out env,
+    non-local)."""
+    monkeypatch.delenv("CHUNKY_BITS_TPU_NO_MMAP", raising=False)
+    data = bytes(range(256)) * 8  # 2048 bytes
+    path = tmp_path / "chunk"
+    path.write_bytes(data)
+
+    async def main():
+        loc = Location.parse(str(path))
+        view = await loc.read_view()
+        assert view is not None and bytes(view) == data
+        # ranged, fully inside the file (incl. extend_zeros interior)
+        ranged = Location.parse(f"(64,128){path}")
+        view = await ranged.read_view()
+        assert bytes(view) == data[64:192]
+        assert bytes(view) == await ranged.read()
+        # range reaching past EOF: generic path owns short/zero semantics
+        over = Location.parse(f"(2000,128){path}")
+        assert await over.read_view() is None
+        # profiler active: generic read must be observed
+        from chunky_bits_tpu.file import new_profiler
+        profiler, reporter = new_profiler()
+        cx = LocationContext(profiler=profiler)
+        assert await loc.read_view(cx) is None
+        # opt-out env
+        monkeypatch.setenv("CHUNKY_BITS_TPU_NO_MMAP", "1")
+        assert await loc.read_view() is None
+        monkeypatch.delenv("CHUNKY_BITS_TPU_NO_MMAP")
+        # missing file: None, not an exception
+        assert await Location.parse(
+            str(tmp_path / "absent")).read_view() is None
+
+    asyncio.run(main())
+
+
+def test_atomic_write_preserves_held_views(tmp_path):
+    """Local writes publish via temp+rename: a view taken before an
+    overwrite keeps serving the old inode's bytes (never SIGBUS, never
+    torn), the path serves the new content, and no temp files leak."""
+    path = tmp_path / "chunk"
+    old, new = b"A" * 4096, b"B" * 4096
+
+    async def main():
+        loc = Location.parse(str(path))
+        await loc.write(old)
+        view = await loc.read_view()
+        assert bytes(view) == old
+        await loc.write(new)  # default policy: overwrite
+        # the held view still reads the old, unlinked inode
+        assert bytes(view) == old
+        assert await loc.read() == new
+        assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+
+    asyncio.run(main())
+
+
+def test_atomic_write_edge_cases(tmp_path):
+    """Symlinked targets are written through (link preserved), file
+    modes survive replacement, negative ranges decline the view path,
+    and streaming local writes publish atomically."""
+
+    async def main():
+        # symlink: write through, don't replace the link node
+        real = tmp_path / "real.bin"
+        real.write_bytes(b"old")
+        link = tmp_path / "link.bin"
+        link.symlink_to(real)
+        await Location.parse(str(link)).write(b"through-the-link")
+        assert link.is_symlink()
+        assert real.read_bytes() == b"through-the-link"
+        # mode preserved across replace
+        secret = tmp_path / "secret.bin"
+        secret.write_bytes(b"v1")
+        os.chmod(secret, 0o600)
+        await Location.parse(str(secret)).write(b"v2")
+        assert os.stat(secret).st_mode & 0o777 == 0o600
+        assert secret.read_bytes() == b"v2"
+        # negative range: view path declines (generic read errors)
+        data = bytes(range(64))
+        f = tmp_path / "f.bin"
+        f.write_bytes(data)
+        neg = Location.parse(f"(-10,5){f}")
+        assert await neg.read_view() is None
+        # streaming write publishes atomically: failed stream leaves
+        # the previous content intact, success leaves no temp files
+        class FailingReader:
+            async def read(self, n: int = -1) -> bytes:
+                raise OSError("stream died")
+
+        out = tmp_path / "out.bin"
+        out.write_bytes(b"previous")
+        with pytest.raises(LocationError, match="stream died"):
+            await Location.parse(str(out)).write_from_reader(
+                FailingReader())
+        assert out.read_bytes() == b"previous"
+        await Location.parse(str(out)).write_from_reader(
+            aio.BytesReader(b"streamed"))
+        assert out.read_bytes() == b"streamed"
+        assert [x for x in os.listdir(tmp_path) if ".tmp." in x] == []
+
+    asyncio.run(main())
