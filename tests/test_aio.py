@@ -105,10 +105,11 @@ def test_file_reader_readinto(tmp_path):
     asyncio.run(main())
 
 
-def test_file_reader_view_parts(tmp_path):
+def test_file_reader_view_parts(tmp_path, monkeypatch):
     """Zero-copy staging views: whole parts served as mmap views that
     advance the stream position, interleaving cleanly with readinto for
     the tail."""
+    monkeypatch.delenv("CHUNKY_BITS_TPU_NO_MMAP", raising=False)
     part = 96
     data = bytes(range(256)) * 2  # 512 bytes = 5 parts + 32-byte tail
 
@@ -135,7 +136,10 @@ def test_file_reader_view_parts(tmp_path):
     asyncio.run(main())
 
 
-def test_file_reader_view_parts_offset_and_unmappable(tmp_path):
+def test_file_reader_view_parts_offset_and_unmappable(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.delenv("CHUNKY_BITS_TPU_NO_MMAP", raising=False)
+
     async def main():
         data = bytes(range(256))
         path = tmp_path / "f.bin"

@@ -438,10 +438,11 @@ def test_read_view_semantics(tmp_path, monkeypatch):
     asyncio.run(main())
 
 
-def test_atomic_write_preserves_held_views(tmp_path):
+def test_atomic_write_preserves_held_views(tmp_path, monkeypatch):
     """Local writes publish via temp+rename: a view taken before an
     overwrite keeps serving the old inode's bytes (never SIGBUS, never
     torn), the path serves the new content, and no temp files leak."""
+    monkeypatch.delenv("CHUNKY_BITS_TPU_NO_MMAP", raising=False)
     path = tmp_path / "chunk"
     old, new = b"A" * 4096, b"B" * 4096
 
