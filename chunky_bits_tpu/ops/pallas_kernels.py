@@ -29,6 +29,17 @@ current (32 KiB, 2) at the top — no headroom left in these knobs; the
 M=R8 dimension (32 rows at p=4) structurally caps MXU row utilization,
 and block-diagonal multi-part stacking trades utilization for zero
 FLOPs one-for-one, so it was not pursued.
+
+Why ~13% MFU is the ceiling for this geometry, not a kernel defect:
+the stationary weight tile is [K8, R8] = [80, 32] of the 128x128 MXU
+array — 15.6% cell occupancy — and the measured 54 GiB/s is ~13.5% of
+the int8 bound, i.e. the kernel runs the array at essentially full
+streaming rate for the cells the math can occupy.  Transposing the
+operands just moves the 32 to the other MXU dimension; padding K8/R8
+to 128 adds zero-FLOP cells one-for-one with occupancy.  Only a wider
+geometry fills it (d=16 -> K8=128; p=16 -> R8=128): at d=10,p=4 the
+HBM roofline (~585 GiB/s data-rate at 14/10 traffic amplification) is
+not the binding constraint, the weight aspect ratio is.
 Accumulation is exact — each dot sums at most K8 ones, far below 2^31.
 """
 
