@@ -187,10 +187,22 @@ class FilePart:
                    coder: Optional[ErasureCoder] = None,
                    backend: Optional[str] = None,
                    batcher=None) -> bytes:
+        """``read_buffers`` joined into one bytes object (padding
+        included; the file reader trims)."""
+        return b"".join(
+            await self.read_buffers(cx, coder, backend, batcher))
+
+    async def read_buffers(self, cx: Optional[LocationContext] = None,
+                           coder: Optional[ErasureCoder] = None,
+                           backend: Optional[str] = None,
+                           batcher=None) -> list:
         """Scattered read: d workers randomly grab chunks from the shared
         d+p pool, falling through each chunk's locations; RS-reconstruct if
-        any data chunk is missing.  Returns d*chunksize bytes (padding
-        included; the file reader trims).
+        any data chunk is missing.  Returns the d data-chunk buffers in
+        order (bytes or zero-copy page-cache views, d*chunksize total,
+        padding included) without joining them — the streaming reader
+        yields them as-is, so a local `cat` moves chunk bytes from the
+        page cache to the output with no intermediate copy.
 
         ``batcher`` (an ops.batching.ReconstructBatcher) coalesces this
         part's reconstruction with other parts in flight into one device
@@ -234,7 +246,7 @@ class FilePart:
                                         batcher, data_only=True)
             slots = [a.tobytes() if isinstance(a, np.ndarray) else a
                      for a in arrays]
-        return b"".join(slots[i] for i in range(d))  # type: ignore[misc]
+        return [slots[i] for i in range(d)]  # type: ignore[misc]
 
     # ---- encode (pure compute half; no I/O) ----
 

@@ -69,7 +69,9 @@ class FileReadBuilder:
         return self.file
 
     async def stream(self) -> AsyncIterator[bytes]:
-        """Yield per-part byte buffers with ``buffer`` parts prefetched.
+        """Yield per-chunk buffers (bytes or zero-copy page-cache views)
+        with ``buffer`` parts prefetched — chunk bytes flow from storage
+        to the consumer without a per-part join copy.
 
         The prefetched parts share one ReconstructBatcher, so a degraded
         read of many parts rebuilds its missing shards in batched device
@@ -104,12 +106,14 @@ class FileReadBuilder:
                         asyncio.ensure_future(
                             self._read_part(part, skip, batcher)))
                     idx += 1
-                data = await tasks.popleft()
-                if len(data) > remaining:
-                    data = data[:remaining]
-                remaining -= len(data)
-                if data:
-                    yield data
+                for data in await tasks.popleft():
+                    if len(data) > remaining:
+                        data = data[:remaining]
+                    remaining -= len(data)
+                    if data:
+                        yield data
+                    if remaining <= 0:
+                        break
                 if remaining <= 0:
                     break
         finally:
@@ -120,14 +124,21 @@ class FileReadBuilder:
             await batcher.aclose()
 
     async def _read_part(self, part: FilePart, skip: int,
-                         batcher=None) -> bytes:
-        # backend resolution happens lazily inside part.read, only when
-        # reconstruction is actually needed
-        data = await part.read(self.cx, backend=self.backend,
-                               batcher=batcher)
-        if len(data) > skip:
-            return data[skip:] if skip else data
-        return b""
+                         batcher=None) -> list:
+        # backend resolution happens lazily inside part.read_buffers,
+        # only when reconstruction is actually needed
+        buffers = await part.read_buffers(self.cx, backend=self.backend,
+                                          batcher=batcher)
+        if not skip:
+            return buffers
+        out = []
+        for buf in buffers:
+            if skip >= len(buf):
+                skip -= len(buf)
+                continue
+            out.append(buf[skip:] if skip else buf)
+            skip = 0
+        return out
 
     def reader(self) -> aio.AsyncByteReader:
         return aio.IterReader(self.stream())

@@ -17,7 +17,13 @@ from typing import AsyncIterator, Optional, Protocol, runtime_checkable
 
 @runtime_checkable
 class AsyncByteReader(Protocol):
-    """Anything with ``async read(n) -> bytes`` (b'' at EOF)."""
+    """Anything with ``async read(n) -> bytes-like`` (b'' at EOF).
+
+    Contract across the module: ``read(-1)`` drains to EOF; ``read(n)``
+    may return fewer than n bytes (never zero before EOF); the value is
+    bytes-like — bytes, bytearray, or memoryview (the zero-copy read
+    pipeline yields page-cache views) — so consumers must treat it as a
+    buffer, not assume ``bytes`` methods."""
 
     async def read(self, n: int = -1) -> bytes:  # pragma: no cover
         ...
@@ -231,7 +237,15 @@ class ZeroExtendReader:
 
 
 class IterReader:
-    """Adapt an async iterator of byte chunks into a reader."""
+    """Adapt an async iterator of byte chunks into a reader.
+
+    Chunks may be any bytes-like object (the read pipeline yields
+    zero-copy page-cache views); a chunk that satisfies a read(n) whole
+    is passed through uncopied, so the dominant cat/gateway path moves
+    buffers from storage to the consumer with no accumulation copy.
+    read(n) may return fewer than ``n`` bytes (but never zero before
+    EOF); read(-1) drains to EOF and returns joined bytes — the
+    module-wide slurp contract."""
 
     def __init__(self, it: AsyncIterator[bytes]):
         self._it = it
@@ -239,20 +253,33 @@ class IterReader:
         self._eof = False
 
     async def read(self, n: int = -1) -> bytes:
-        if self._eof and not self._pending:
+        if n < 0:
+            parts = [self._pending] if self._pending else []
+            self._pending = b""
+            while not self._eof:
+                try:
+                    parts.append(await self._it.__anext__())
+                except StopAsyncIteration:
+                    self._eof = True
+            return b"".join(parts)
+        if self._pending:
+            if n < 0 or len(self._pending) <= n:
+                out, self._pending = self._pending, b""
+            else:
+                out, self._pending = self._pending[:n], self._pending[n:]
+            return out
+        if self._eof:
             return b""
-        while n < 0 or len(self._pending) < n:
-            try:
-                chunk = await self._it.__anext__()
-            except StopAsyncIteration:
-                self._eof = True
-                break
-            self._pending += chunk
-        if n < 0 or len(self._pending) <= n:
-            out, self._pending = self._pending, b""
-        else:
-            out, self._pending = self._pending[:n], self._pending[n:]
-        return out
+        try:
+            chunk = await self._it.__anext__()
+        except StopAsyncIteration:
+            self._eof = True
+            return b""
+        if len(chunk) <= n:
+            return chunk  # pass through, no copy
+        view = memoryview(chunk)
+        self._pending = view[n:]
+        return view[:n]
 
 
 async def read_exact_into(reader: AsyncByteReader, mem: memoryview) -> int:

@@ -178,3 +178,34 @@ def test_view_parts_opt_out(tmp_path, monkeypatch):
         await r.close()
 
     asyncio.run(main())
+
+
+def test_iter_reader_contract():
+    """IterReader: read(-1) drains to EOF as joined bytes; read(n)
+    passes whole chunks through uncopied (short reads allowed) and
+    splits oversized chunks via views; b'' only at EOF."""
+
+    async def chunks():
+        yield b"aaaa"
+        yield memoryview(b"bbbbbbbb")
+        yield b"cc"
+
+    async def main():
+        # slurp drains everything as bytes
+        r = aio.IterReader(chunks())
+        assert await r.read() == b"aaaabbbbbbbbcc"
+        assert await r.read() == b""
+        # bounded reads: pass-through, then split, then drain
+        r = aio.IterReader(chunks())
+        assert bytes(await r.read(100)) == b"aaaa"  # short, not padded
+        first = await r.read(3)
+        assert bytes(first) == b"bbb"
+        assert bytes(await r.read(100)) == b"bbbbb"  # pending remainder
+        # slurp after bounded reads picks up pending + rest
+        r = aio.IterReader(chunks())
+        head = await r.read(2)
+        assert bytes(head) == b"aa"
+        assert await r.read() == b"aabbbbbbbbcc"
+        assert await r.read(5) == b""
+
+    asyncio.run(main())

@@ -229,13 +229,13 @@ def test_take_limited_read_ignores_trailing_parts(tmp_path):
             os.remove(c.locations[0].target)
 
         reads = []
-        orig = fp_mod.FilePart.read
+        orig = fp_mod.FilePart.read_buffers
 
         async def counting(self, *a, **kw):
             reads.append(self)
             return await orig(self, *a, **kw)
 
-        fp_mod.FilePart.read = counting
+        fp_mod.FilePart.read_buffers = counting
         try:
             part_bytes = d_ * chunk
             got = await (FileReadBuilder(ref).with_seek(100)
@@ -244,7 +244,7 @@ def test_take_limited_read_ignores_trailing_parts(tmp_path):
             # only the two parts overlapping the window were read
             assert len(reads) == 2
         finally:
-            fp_mod.FilePart.read = orig
+            fp_mod.FilePart.read_buffers = orig
 
         with pytest.raises(FileReadError):
             await FileReadBuilder(ref).read_all()
