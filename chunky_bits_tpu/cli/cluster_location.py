@@ -25,6 +25,7 @@ from chunky_bits_tpu.file import (
     Location,
 )
 from chunky_bits_tpu.utils import aio
+from chunky_bits_tpu.utils.yamlio import yaml_load
 
 _warned_once: set[str] = set()
 
@@ -107,7 +108,7 @@ class ClusterLocation:
 
             data = await self.location.read()
             try:
-                obj = yaml.safe_load(data)
+                obj = yaml_load(data)
             except yaml.YAMLError as err:
                 raise SerdeError(
                     f"invalid file reference at {self.location}: {err}"

@@ -18,6 +18,7 @@ import yaml
 from chunky_bits_tpu.cli.any_destination import AnyDestinationRef
 from chunky_bits_tpu.cluster import Cluster, ClusterProfile, sized_int
 from chunky_bits_tpu.errors import ChunkyBitsError, SerdeError
+from chunky_bits_tpu.utils.yamlio import yaml_load
 
 DEFAULT_CONFIG_PATH = "/etc/chunky-bits.yaml"
 _KNOWN_FIELDS = {"clusters", "default_destination", "default_profile"}
@@ -85,7 +86,7 @@ class Config:
 
         data = await asyncio.to_thread(_read)
         try:
-            obj = yaml.safe_load(data)
+            obj = yaml_load(data)
         except yaml.YAMLError as err:
             raise SerdeError(f"invalid config {target}: {err}") from err
         return cls.from_obj(obj or {})

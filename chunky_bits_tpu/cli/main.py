@@ -20,7 +20,6 @@ import json
 import os
 import sys
 
-import yaml
 
 from chunky_bits_tpu.cli.cluster_location import ClusterLocation
 from chunky_bits_tpu.cli.config import Config
@@ -28,6 +27,7 @@ from chunky_bits_tpu.errors import ChunkyBitsError
 from chunky_bits_tpu.file import AnyHash, Location
 from chunky_bits_tpu.ops import get_coder
 from chunky_bits_tpu.utils import aio
+from chunky_bits_tpu.utils.yamlio import yaml_dump
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -132,7 +132,7 @@ def _dump(obj, as_json: bool) -> None:
         json.dump(obj, sys.stdout, indent=2)
         print()
     else:
-        yaml.safe_dump(obj, sys.stdout, sort_keys=False)
+        yaml_dump(obj, sys.stdout, sort_keys=False)
 
 
 def _shard_geometry(args, targets: list) -> tuple[int, int]:

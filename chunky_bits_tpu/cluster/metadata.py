@@ -27,6 +27,7 @@ from chunky_bits_tpu.errors import (
     SerdeError,
 )
 from chunky_bits_tpu.file.location import Location
+from chunky_bits_tpu.utils.yamlio import yaml_load, yaml_dump
 
 JSON = "json"
 JSON_PRETTY = "json-pretty"
@@ -48,13 +49,13 @@ class MetadataFormat:
             return json.dumps(payload, separators=(",", ":"))
         if self.name == JSON_PRETTY:
             return json.dumps(payload, indent=2)
-        return yaml.safe_dump(payload, sort_keys=False)
+        return yaml_dump(payload, sort_keys=False)
 
     def from_bytes(self, data: bytes):
         try:
             if self.name == JSON_STRICT:
                 return json.loads(data)
-            return yaml.safe_load(data)
+            return yaml_load(data)
         except (json.JSONDecodeError, yaml.YAMLError) as err:
             raise SerdeError(str(err)) from err
 

@@ -244,7 +244,11 @@ class FilePart:
             ]
             arrays = await _reconstruct(arrays, d, p, coder, backend,
                                         batcher, data_only=True)
-            slots = [a.tobytes() if isinstance(a, np.ndarray) else a
+            # rebuilt rows stay as buffers (memoryview over the array) —
+            # every consumer downstream (join, hashing, socket/stdout
+            # writes) takes buffer objects, so no tobytes copy
+            slots = [memoryview(np.ascontiguousarray(a))
+                     if isinstance(a, np.ndarray) else a
                      for a in arrays]
         return [slots[i] for i in range(d)]  # type: ignore[misc]
 

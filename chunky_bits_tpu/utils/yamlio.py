@@ -1,0 +1,30 @@
+"""YAML via libyaml's C loader/dumper when available.
+
+Every metadata object (cluster definitions, file references — including
+the reference's non-strict JSON formats, which parse through YAML as a
+superset, src/cluster/metadata.rs:364-414) crosses this boundary.  The
+pure-Python scanner costs ~1 s just to parse a 1 GiB object's file
+reference (~90 parts x 5 chunks); the C loader is ~10x faster with
+identical semantics.  Falls back to the pure-Python classes when PyYAML
+was built without libyaml.
+"""
+
+from __future__ import annotations
+
+import yaml
+
+_LOADER = getattr(yaml, "CSafeLoader", yaml.SafeLoader)
+_DUMPER = getattr(yaml, "CSafeDumper", yaml.SafeDumper)
+
+
+def yaml_load(data):
+    """``yaml.safe_load`` semantics, C-accelerated."""
+    return yaml.load(data, Loader=_LOADER)
+
+
+def yaml_dump(obj, stream=None, **kwargs):
+    """``yaml.safe_dump`` semantics, C-accelerated.  Defaults match
+    safe_dump (block style) so serialized metadata is byte-identical to
+    the pure-Python emitter's."""
+    kwargs.setdefault("default_flow_style", False)
+    return yaml.dump(obj, stream, Dumper=_DUMPER, **kwargs)
