@@ -128,10 +128,16 @@ class Cluster:
         return batcher
 
     def get_file_writer(self, profile: ClusterProfile) -> FileWriteBuilder:
-        # A device backend amortizes dispatch overhead by staging several
-        # parts into one batched encode (writer.py batch staging) and by
-        # coalescing across concurrent writes (shared encode batcher).
-        batch_parts = 8 if self.tunables.is_device_backend() else 1
+        # Staging several parts per encode dispatch amortizes per-part
+        # overhead for every backend: device backends save dispatch RPC,
+        # and the CPU backends save the per-part to_thread/orchestration
+        # machinery (the writer's staging groups full parts as zero-copy
+        # slices, so unlike the batcher's concatenate this costs no extra
+        # memcpy — measured +17% on config 2 native, more at small d
+        # where per-part overhead looms larger).  Device backends
+        # additionally coalesce across concurrent writes (shared encode
+        # batcher).
+        batch_parts = 8
         return (
             FileWriteBuilder()
             .with_destination(self.get_destination(profile))
