@@ -525,3 +525,39 @@ def test_mmap_source_device_backend_identity(tmp_path, backend, monkeypatch):
             == [c.hash for part in plain.parts for c in part.all_chunks()]
 
     asyncio.run(main())
+
+
+def test_random_seek_take_sweep(tmp_path):
+    """Randomized guard for the per-buffer trimming arithmetic in the
+    join-free streaming reader: any (seek, take) window must yield
+    exactly payload[seek:seek+take], including windows straddling part
+    and chunk boundaries, zero-length windows, and past-EOF tails."""
+    d, p, chunk = 3, 2, 512
+    payload = synthetic_bytes(d * chunk * 7 + 313, seed=73)
+    dirs = []
+    for i in range(5):
+        dd = tmp_path / f"disk{i}"
+        dd.mkdir()
+        dirs.append(Location.parse(str(dd)))
+
+    async def main():
+        ref = await (FileWriteBuilder()
+                     .with_destination(LocationsDestination(dirs))
+                     .with_chunk_size(chunk)
+                     .with_data_chunks(d)
+                     .with_parity_chunks(p)
+                     .with_batch_parts(4)
+                     .write(aio.BytesReader(payload)))
+        rng = random.Random(73)
+        n = len(payload)
+        cases = [(0, 0), (0, n), (n, 10), (n - 1, 5), (chunk, chunk),
+                 (d * chunk, d * chunk)]
+        cases += [(rng.randrange(0, n + 20), rng.randrange(0, n + 20))
+                  for _ in range(40)]
+        for seek, take in cases:
+            got = await (FileReadBuilder(ref).with_seek(seek)
+                         .with_take(take).read_all())
+            want = payload[seek:seek + take] if take else payload[seek:]
+            assert got == want, (seek, take, len(got), len(want))
+
+    asyncio.run(main())
