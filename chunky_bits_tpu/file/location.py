@@ -16,6 +16,7 @@ reads Content-Length from a HEAD response.
 from __future__ import annotations
 
 import asyncio
+import errno
 import io
 import os
 import time
@@ -135,8 +136,11 @@ def default_context() -> LocationContext:
     return _DEFAULT_CONTEXT
 
 
-def _atomic_publish(target: str, data) -> None:
-    """Local whole-buffer write, published atomically where possible.
+async def _publish_atomically(target: str, write_body) -> int:
+    """Local write published atomically where possible; the single
+    implementation of the publish protocol for both the whole-buffer and
+    streaming local write paths (``write_body(path) -> int`` lands the
+    bytes at the given path).
 
     Regular-file targets are written to a sibling temp file and
     os.replace()d in, so a concurrent reader (including page-cache views
@@ -146,18 +150,18 @@ def _atomic_publish(target: str, data) -> None:
     follows the filesystem's rename semantics (flush, no fsync —
     matching the reference's flush-only behavior): after power loss the
     path holds the old content, the new content, or on some filesystems
-    an empty file, but never a torn mix.  Symlinks (write through,
-    preserving the link) and special targets (devices, fifos — rename
-    would replace the node) keep the direct write.  An existing regular
-    file's permission bits carry over to the replacement; hard links
+    an empty file, but never a torn mix.  Direct writes are kept for
+    symlinks (write through, preserving the link), special targets
+    (devices, fifos — rename would replace the node), and as a fallback
+    when the parent directory refuses temp creation (EACCES/EPERM/EROFS
+    — the in-place write only needs permission on the file itself).  An
+    existing regular file's permission bits carry over to the
+    replacement; ownership becomes the writing process's and hard links
     detach — correct for content-addressed chunks, where an in-place
     rewrite would mutate every linked path."""
     if os.path.islink(target) or (
             os.path.exists(target) and not os.path.isfile(target)):
-        with open(target, "wb") as f:
-            f.write(data)
-            f.flush()
-        return
+        return await write_body(target)
     mode = None
     try:
         mode = os.stat(target).st_mode & 0o7777
@@ -165,46 +169,43 @@ def _atomic_publish(target: str, data) -> None:
         pass
     tmp = f"{target}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
     try:
-        with open(tmp, "wb") as f:
-            f.write(data)
-            f.flush()
-        if mode is not None:
-            os.chmod(tmp, mode)
-        os.replace(tmp, target)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
-
-
-async def _atomic_publish_stream(reader, target: str) -> int:
-    """Streaming variant of ``_atomic_publish`` (same target rules):
-    the stream lands in a sibling temp file and is renamed in, so
-    readers never see a partially-written file and a failed stream
-    leaves the previous content intact."""
-    if os.path.islink(target) or (
-            os.path.exists(target) and not os.path.isfile(target)):
-        return await aio.copy_reader_to_file(reader, target)
-    mode = None
-    try:
-        mode = os.stat(target).st_mode & 0o7777
-    except OSError:
-        pass
-    tmp = f"{target}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
-    try:
-        total = await aio.copy_reader_to_file(reader, tmp)
+        total = await write_body(tmp)
         if mode is not None:
             os.chmod(tmp, mode)
         os.replace(tmp, target)
         return total
+    except OSError as err:
+        created = os.path.exists(tmp)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        if not created and err.errno in (errno.EACCES, errno.EPERM,
+                                         errno.EROFS):
+            return await write_body(target)
+        raise
     except BaseException:
         try:
             os.unlink(tmp)
         except OSError:
             pass
         raise
+
+
+async def _atomic_publish(target: str, data) -> None:
+    def _write(path: str) -> int:
+        with open(path, "wb") as f:
+            f.write(data)
+            f.flush()
+        return len(data)
+
+    await _publish_atomically(
+        target, lambda path: asyncio.to_thread(_write, path))
+
+
+async def _atomic_publish_stream(reader, target: str) -> int:
+    return await _publish_atomically(
+        target, lambda path: aio.copy_reader_to_file(reader, path))
 
 
 class _HttpBodyReader:
@@ -514,7 +515,7 @@ class Location:
         for clusters whose storage is shared with such writers."""
         cx = cx or default_context()
         if (not self.is_local() or cx.profiler is not None
-                or os.environ.get("CHUNKY_BITS_TPU_NO_MMAP")):
+                or aio.mmap_opted_out()):
             return None
         rng = self.range
 
@@ -557,8 +558,7 @@ class Location:
                 return
             if self.is_local():
                 try:
-                    await asyncio.to_thread(_atomic_publish, self.target,
-                                            data)
+                    await _atomic_publish(self.target, data)
                 except OSError as err:
                     raise LocationError(str(err)) from err
             else:

@@ -165,9 +165,10 @@ def make_app(cluster: Cluster,
             elif parsed[0] == "prefix":
                 builder = builder.with_seek(parsed[1])
             else:  # suffix
-                length = parsed[1]
-                if length > total:
-                    return web.Response(status=416)
+                # RFC 9110 §14.1.2: a suffix length >= the representation
+                # length selects the ENTIRE representation (it is
+                # satisfiable), so clamp rather than 416
+                length = min(parsed[1], total)
                 builder = builder.with_seek(total - length).with_take(length)
             if builder.len_bytes() == 0:
                 return web.Response(status=416)

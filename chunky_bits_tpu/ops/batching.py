@@ -177,13 +177,16 @@ class EncodeHashBatcher(_CoalescingBatcher):
     batcher coalesces *across* concurrent writes — the many-small-objects
     regime (e.g. parallel HTTP-gateway PUTs), where each write has a
     single sub-batch part and per-dispatch overhead would dominate.
-    Grouped by (d, p, shard length); payload batches are concatenated into
-    one ``[ΣB, d, S]`` ``encode_hash_batch`` call.
+    Grouped by (d, p, shard length).
 
-    The concatenation copies each staged batch once more host-side, which
-    is why the cluster engages this only for device backends (the native
-    path keeps its zero-copy fused pass — an extra memcpy would cost more
-    than the per-call overhead it saves).
+    Whether a group's batches are additionally merged into one
+    ``[ΣB, d, S]`` dispatch follows the backend's
+    ``prefers_merged_batches`` policy (see ``_run_group``): device
+    backends earn the merge's extra concatenate copy back in saved
+    per-dispatch RPC; CPU backends run the group's batches back-to-back
+    unmerged.  The cluster wires a shared instance only for device
+    backends — CPU writes already amortize per-part overhead through the
+    writer's zero-copy staging.
     """
 
     async def encode_hash(

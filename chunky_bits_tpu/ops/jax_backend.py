@@ -226,12 +226,21 @@ class JaxBackend(ErasureBackend):
             futs.append(pool.submit(
                 hash_rows, arr, parity_digests[lo:lo + arr.shape[0]]))
 
+        was_on_tpu = self._on_tpu
         parity = self.apply_matrix(mat, shards, on_block=on_block)
         for f in futs:
             f.result()
+        if was_on_tpu and not self._on_tpu:
+            # A mid-run pallas failure fell back to einsum: the RETURNED
+            # parity is the einsum recomputation, but digests hashed from
+            # blocks the failed pallas attempt delivered would describe
+            # that attempt's bytes.  The fallback fires exactly when the
+            # kernel is misbehaving, so none of its output is trusted —
+            # rehash every parity row from the parity actually returned.
+            covered[:] = False
         if not covered.all():
-            # a mid-run pallas->einsum fallback suppresses the callback
-            # for its retry; hash the rows no callback ever delivered
+            # also: the fallback suppresses the callback for its einsum
+            # retry, so rows delivered by no callback are hashed here
             idx = np.flatnonzero(~covered)
             rest = np.empty((len(idx), r, 32), dtype=np.uint8)
             hash_rows(np.ascontiguousarray(parity[idx]), rest)

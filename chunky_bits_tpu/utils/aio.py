@@ -15,6 +15,14 @@ import os
 from typing import AsyncIterator, Optional, Protocol, runtime_checkable
 
 
+def mmap_opted_out() -> bool:
+    """True when ``CHUNKY_BITS_TPU_NO_MMAP`` is set to a truthy value
+    (standard env-flag parsing: unset, "", "0", "false", "no", "off"
+    all mean the zero-copy mmap paths stay ON)."""
+    return os.environ.get("CHUNKY_BITS_TPU_NO_MMAP", "").strip().lower() \
+        not in ("", "0", "false", "no", "off")
+
+
 @runtime_checkable
 class AsyncByteReader(Protocol):
     """Anything with ``async read(n) -> bytes-like`` (b'' at EOF).
@@ -79,7 +87,7 @@ class FileReader:
         if self._mm is None:
             import mmap
 
-            if os.environ.get("CHUNKY_BITS_TPU_NO_MMAP"):
+            if mmap_opted_out():
                 # opt-out for sources that may be truncated concurrently
                 # (see view_parts docstring)
                 self._mm = self._NO_MAP
@@ -263,7 +271,7 @@ class IterReader:
                     self._eof = True
             return b"".join(parts)
         if self._pending:
-            if n < 0 or len(self._pending) <= n:
+            if len(self._pending) <= n:  # n < 0 already drained above
                 out, self._pending = self._pending, b""
             else:
                 out, self._pending = self._pending[:n], self._pending[n:]

@@ -209,3 +209,17 @@ def test_iter_reader_contract():
         assert await r.read(5) == b""
 
     asyncio.run(main())
+
+
+def test_mmap_opt_out_env_parsing(monkeypatch):
+    """Standard env-flag semantics: unset/empty/0/false/no/off keep the
+    mmap paths ON; truthy values opt out."""
+    for val in (None, "", "0", "false", "No", "OFF"):
+        if val is None:
+            monkeypatch.delenv("CHUNKY_BITS_TPU_NO_MMAP", raising=False)
+        else:
+            monkeypatch.setenv("CHUNKY_BITS_TPU_NO_MMAP", val)
+        assert not aio.mmap_opted_out(), repr(val)
+    for val in ("1", "true", "yes", "anything"):
+        monkeypatch.setenv("CHUNKY_BITS_TPU_NO_MMAP", val)
+        assert aio.mmap_opted_out(), repr(val)

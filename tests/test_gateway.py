@@ -165,10 +165,15 @@ def test_gateway_end_to_end(tmp_path):
                 "/objects/data.bin",
                 headers={"Range": f"bytes={len(payload) + 10}-"})
             assert resp.status == 416
+            # oversized suffix selects the entire representation
+            # (RFC 9110 14.1.2 — satisfiable, not 416)
             resp = await client.get(
                 "/objects/data.bin",
                 headers={"Range": f"bytes=-{len(payload) + 10}"})
-            assert resp.status == 416
+            assert resp.status == 206
+            assert await resp.read() == payload
+            assert resp.headers["Content-Range"] == \
+                f"bytes 0-{len(payload) - 1}/{len(payload)}"
             # unparseable / multi-range / unknown-unit Range headers are
             # ignored per RFC 9110, not rejected
             for header in ("bytes=0-5,10-20", "chars=0-5", "garbage"):
