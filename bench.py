@@ -62,6 +62,50 @@ def _arm_if_device_backend(backend, metric: str):
     return _device_init_watchdog(metric)
 
 
+def marginal_seconds(body_fn, x, iters: int) -> float:
+    """Marginal per-iteration device time of ``body_fn`` inside an
+    on-device loop, measured as a difference across loop lengths so
+    constant per-dispatch overhead (and anything XLA hoists) cancels.
+    The loop body is made iteration-dependent by XORing the scalar
+    carry into the input — a cheap, unhoistable pass whose cost the
+    caller measures once with ``body_fn=lambda y: y`` and subtracts.
+    Returns -1.0 when the two slopes disagree (non-linear scaling —
+    the measurement is invalid).  Shared by bench.py and exp_packed.py
+    so A/B numbers from the two scripts stay comparable."""
+    import jax
+    import jax.numpy as jnp
+
+    def make(n):
+        def loop(x):
+            def body(i, acc):
+                y = x ^ (acc & 0xFF).astype(jnp.uint8)
+                out = body_fn(y)
+                return acc + out[i % x.shape[0], 0, ::4096].astype(
+                    jnp.uint32).sum()
+            return jax.lax.fori_loop(0, n, body, jnp.uint32(0))
+        return jax.jit(loop)
+
+    def best_time(f):
+        int(f(x))  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.time()
+            int(f(x))
+            best = min(best, time.time() - t0)
+        return best
+
+    n1, n2, n3 = max(1, iters // 5), iters, 2 * iters
+    t1, t2, t3 = (best_time(make(n)) for n in (n1, n2, n3))
+    m12 = (t2 - t1) / (n2 - n1)
+    m23 = (t3 - t2) / (n3 - n2)
+    if m12 <= 0 or m23 <= 0 or not (0.4 <= m12 / m23 <= 2.5):
+        print(f"# warning: non-linear loop scaling "
+              f"(m12={m12 * 1e3:.3f}ms m23={m23 * 1e3:.3f}ms)",
+              file=sys.stderr)
+        return -1.0
+    return (t3 - t1) / (n3 - n1)
+
+
 def main() -> None:
     ready = _device_init_watchdog("rs_parity_encode_gibps")
 
@@ -104,42 +148,7 @@ def main() -> None:
         return lambda x: apply_bitplane(m2, x)
 
     def _marginal_seconds(body_fn, x) -> float:
-        """Marginal per-iteration device time of ``body_fn`` inside an
-        on-device loop, measured as a difference across loop lengths so
-        constant per-dispatch overhead (and anything XLA hoists) cancels.
-        The loop body is made iteration-dependent by XORing the scalar
-        carry into the input — a cheap, unhoistable pass whose cost is
-        subtracted separately by the caller."""
-
-        def make(n):
-            def loop(x):
-                def body(i, acc):
-                    y = x ^ (acc & 0xFF).astype(jnp.uint8)
-                    out = body_fn(y)
-                    return acc + out[i % x.shape[0], 0, ::4096].astype(
-                        jnp.uint32).sum()
-                return jax.lax.fori_loop(0, n, body, jnp.uint32(0))
-            return jax.jit(loop)
-
-        def best_time(f):
-            int(f(x))  # compile + warm
-            best = float("inf")
-            for _ in range(3):
-                t0 = time.time()
-                int(f(x))
-                best = min(best, time.time() - t0)
-            return best
-
-        n1, n2, n3 = max(1, iters // 5), iters, 2 * iters
-        t1, t2, t3 = (best_time(make(n)) for n in (n1, n2, n3))
-        m12 = (t2 - t1) / (n2 - n1)
-        m23 = (t3 - t2) / (n3 - n2)
-        if m12 <= 0 or m23 <= 0 or not (0.4 <= m12 / m23 <= 2.5):
-            print(f"# warning: non-linear loop scaling "
-                  f"(m12={m12 * 1e3:.3f}ms m23={m23 * 1e3:.3f}ms)",
-                  file=sys.stderr)
-            return -1.0
-        return (t3 - t1) / (n3 - n1)
+        return marginal_seconds(body_fn, x, iters)
 
     _xor_cost_cache: dict[int, float] = {}
 

@@ -87,6 +87,91 @@ def _host_matrix(mat_bytes: bytes, r: int, k: int) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=64)
+def _build_packed_kernel(r: int, k: int, tile_s: int, bblock: int,
+                         interpret: bool):
+    """Field-multiplexed variant of the fused kernel: two data columns
+    share one int8 MXU element.
+
+    Column ``t`` of the tile's left half and column ``t + TS/2`` of its
+    right half pack into one bit-plane element at bit offsets 0 and 6,
+    and the contraction is split in half (block-diagonal weight
+    ``[2*R8, K8]``), so each field's popcount stays <= ceil(K8/2) <= 63
+    and the two fields never collide inside the int32 accumulator
+    (``acc = P_lo + 64*P_hi`` exactly).  The dot then streams TS/2
+    columns instead of TS through the MXU — at encode geometry
+    (R8=32) the array spends half the column-passes of the standard
+    kernel for the same math, and the bit-plane scratch halves too.
+    Field extraction is exact: ``acc >> 6 == P_hi`` because
+    ``P_lo < 64``, and ``(x + y) & 1 == (x ^ y) & 1`` recombines the
+    two contraction halves' parities without a carry chain.
+
+    Only valid when ``2*R8 <= 128`` (the doubled output keeps to one
+    MXU weight tile — true for parity encode, p <= 8) and
+    ``K8 <= 126`` (field popcounts fit 6 bits — d <= 15); callers gate
+    and fall back to the standard kernel otherwise.
+    """
+    jax = _jx()
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r8, k8 = r * 8, k * 8
+    kc = k8 // 2
+    h = tile_s // 2
+
+    def kernel(m2p_ref, data_ref, out_ref, bits_ref):
+        for bi in range(bblock):
+            data = data_ref[bi].astype(jnp.int32)  # [K, TS]
+            lo = data[:, :h]
+            hi = data[:, h:]
+            for b in range(8):
+                bits_ref[b * k:(b + 1) * k, :] = (
+                    ((lo >> b) & 1) | (((hi >> b) & 1) << 6)
+                ).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                m2p_ref[...], bits_ref[...],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )  # [2*R8, h]
+            a0 = acc[0:r8, :]
+            a1 = acc[r8:2 * r8, :]
+            lo_bits = (a0 ^ a1) & 1
+            hi_bits = ((a0 >> 6) ^ (a1 >> 6)) & 1
+            plo = lo_bits[0:r, :]
+            phi = hi_bits[0:r, :]
+            for b in range(1, 8):
+                plo = plo | (lo_bits[b * r:(b + 1) * r, :] << b)
+                phi = phi | (hi_bits[b * r:(b + 1) * r, :] << b)
+            out_ref[bi] = jnp.concatenate(
+                [plo, phi], axis=1).astype(jnp.uint8)
+
+    def call(m2, data):
+        batch, _k, s = data.shape
+        # block-diagonal split of the contraction: rows 0..R8 see the
+        # first kc bit-columns, rows R8..2*R8 the rest
+        col = jnp.arange(k8, dtype=jnp.int32)[None, :]
+        m2p = jnp.concatenate(
+            [jnp.where(col < kc, m2, 0), jnp.where(col >= kc, m2, 0)],
+            axis=0)  # [2*R8, K8] int8
+        grid = (batch // bblock, s // tile_s)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((2 * r8, k8), lambda b, j: (0, 0)),
+                pl.BlockSpec((bblock, k, tile_s), lambda b, j: (b, 0, j)),
+            ],
+            out_specs=pl.BlockSpec((bblock, r, tile_s),
+                                   lambda b, j: (b, 0, j)),
+            out_shape=jax.ShapeDtypeStruct((batch, r, s), jnp.uint8),
+            scratch_shapes=[pltpu.VMEM((k8, h), jnp.int8)],
+            interpret=interpret,
+        )(m2p, data)
+
+    return jax.jit(call)
+
+
+@functools.lru_cache(maxsize=64)
 def _build_kernel(r: int, k: int, tile_s: int, bblock: int, interpret: bool,
                   pack: bool = True):
     """``pack=True`` emits packed parity bytes [B, R, S] (the fused
@@ -187,6 +272,36 @@ def apply_m2_bitmajor(m2, shards, *, interpret: bool = False):
         raise ValueError(f"shard size {s} not tileable for pallas path")
     bblock = 2 if b % 2 == 0 else 1
     fn = _build_kernel(r, k, tile, bblock, interpret)
+    return fn(m2, shards)
+
+
+def packed_geometry_ok(r: int, k: int, s: int) -> bool:
+    """Gate for the field-multiplexed kernel: doubled output rows must
+    keep to one MXU weight tile (2*R8 <= 128, i.e. r <= 8) and per-field
+    popcounts must fit 6 bits (ceil(K8/2) <= 63, i.e. k <= 15); the
+    column split needs lane-aligned tile halves (s a multiple of 256)."""
+    return 0 < r <= 8 and 0 < k <= 15 and s % 256 == 0
+
+
+def apply_m2_bitmajor_packed(m2, shards, *, interpret: bool = False):
+    """Field-multiplexed fused transform (see ``_build_packed_kernel``):
+    same contract as ``apply_m2_bitmajor``, restricted to geometries
+    where ``packed_geometry_ok`` holds.  Raises ValueError otherwise."""
+    r8, k8 = m2.shape
+    r, k = r8 // 8, k8 // 8
+    b, k2, s = shards.shape
+    assert k2 == k
+    if not packed_geometry_ok(r, k, s):
+        raise ValueError(
+            f"geometry r={r} k={k} s={s} outside the packed kernel's gate")
+    # _pick_tile's VMEM budget is conservative here (the packed scratch
+    # is [K8, tile/2], half the standard kernel's); tile halves stay
+    # lane-aligned because the gate requires s % 256 == 0
+    tile = _pick_tile(s, k)
+    if tile < 256:
+        raise ValueError(f"shard size {s} not tileable for packed path")
+    bblock = 2 if b % 2 == 0 else 1
+    fn = _build_packed_kernel(r, k, tile, bblock, interpret)
     return fn(m2, shards)
 
 

@@ -1,0 +1,68 @@
+"""On-chip A/B: standard fused kernel vs field-multiplexed packed kernel.
+
+Shares bench.py's marginal-cost method (per-iteration device time of the
+transform inside a fori_loop, differenced across loop lengths so dispatch
+overhead and hoistable work cancel) so numbers are comparable with the
+recorded bench figures.
+"""
+import sys
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from bench import marginal_seconds
+from chunky_bits_tpu.ops import matrix
+from chunky_bits_tpu.ops.pallas_kernels import (
+    _build_kernel, _build_packed_kernel, bit_matrix_bitmajor)
+
+d, p = 10, 4
+batch, size = 128, 1 << 20
+iters = 10
+
+enc = matrix.build_encode_matrix(d, p)[d:]
+m2 = jnp.asarray(bit_matrix_bitmajor(enc).astype(np.int8))
+rng = np.random.default_rng(0)
+data = rng.integers(0, 256, (batch, d, size), dtype=np.uint8)
+x = jnp.asarray(data)
+
+xor_cost = marginal_seconds(lambda y: y, x, iters)
+if xor_cost < 0:
+    sys.exit("xor baseline did not scale linearly; rerun")
+print(f"xor pass: {xor_cost*1e3:.2f} ms")
+
+
+def gibps(secs):
+    if secs <= xor_cost:
+        return 0.0
+    return batch * d * size / (secs - xor_cost) / (1 << 30)
+
+
+# correctness gate on-chip: every config must match the standard kernel
+std_ref = _build_kernel(p, d, 8192, 1, False)
+want = np.asarray(std_ref(m2, x[:4, :, :65536]))
+
+configs = [
+    ("std", 32768, 2, _build_kernel(p, d, 32768, 2, False)),
+    ("packed", 32768, 2, _build_packed_kernel(p, d, 32768, 2, False)),
+    ("packed", 65536, 2, _build_packed_kernel(p, d, 65536, 2, False)),
+    ("packed", 32768, 4, _build_packed_kernel(p, d, 32768, 4, False)),
+]
+
+failed = False
+for name, tile, bblock, fn in configs:
+    got = np.asarray(fn(m2, x[:4, :, :65536]))
+    if not np.array_equal(want, got):
+        print(f"{name} tile={tile} bblock={bblock}: IDENTITY FAIL")
+        failed = True
+        continue
+    t = marginal_seconds(lambda y, fn=fn: fn(m2, y), x, iters)
+    if t < 0:
+        print(f"{name:7s} tile={tile:6d} bblock={bblock}: non-linear "
+              f"scaling, no measurement")
+        continue
+    print(f"{name:7s} tile={tile:6d} bblock={bblock}: {gibps(t):6.1f} GiB/s"
+          f"  ({(t - xor_cost)*1e3:.2f} ms marginal)")
+
+if failed:
+    sys.exit(1)

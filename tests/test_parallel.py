@@ -162,6 +162,39 @@ def test_pallas_kernel_interpret_identity():
         assert np.array_equal(got, want), batch
 
 
+def test_packed_kernel_interpret_identity():
+    """The field-multiplexed kernel (two data columns per int8 MXU
+    element, contraction split so per-field popcounts never collide)
+    must match the oracle byte-for-byte at every gated geometry,
+    including the gate's edges (p=8 doubles output rows to the full MXU
+    tile; d=15 puts ceil(K8/2)=60 popcounts one step under the 6-bit
+    field ceiling)."""
+    import jax.numpy as jnp
+
+    from chunky_bits_tpu.ops.pallas_kernels import (
+        apply_m2_bitmajor_packed,
+        bitmajor_device_matrix,
+        packed_geometry_ok,
+    )
+
+    rng = np.random.default_rng(5)
+    for d, p, batch, s in [(10, 4, 2, 512), (10, 4, 3, 256), (3, 2, 2, 256),
+                           (15, 8, 2, 256), (8, 8, 2, 256)]:
+        assert packed_geometry_ok(p, d, s)
+        enc = matrix.build_encode_matrix(d, p)
+        data = rng.integers(0, 256, (batch, d, s), dtype=np.uint8)
+        m2 = bitmajor_device_matrix(enc[d:])
+        got = np.asarray(apply_m2_bitmajor_packed(
+            m2, jnp.asarray(data), interpret=True))
+        want = ErasureCoder(d, p, NumpyBackend()).encode_batch(data)
+        assert np.array_equal(got, want), (d, p, batch, s)
+
+    # outside the gate: p>8 (two weight tiles), d>15 (field overflow),
+    # and lane-misaligned tile halves must all be refused
+    for r, k, s in [(9, 10, 512), (4, 16, 512), (4, 10, 128)]:
+        assert not packed_geometry_ok(r, k, s)
+
+
 def test_sharded_apply_pallas_impl_identity(eight_devices):
     """The fused-kernel mesh impl (what TPU meshes auto-select), run in
     interpret mode on the virtual CPU mesh, matches the oracle through
