@@ -253,7 +253,23 @@ def _pick_tile(s: int, k: int, row_bytes: int = 0) -> int:
     return tile if s % tile == 0 else 0
 
 
-def apply_m2_bitmajor(m2, shards, *, interpret: bool = False):
+#: default for the field-multiplexed kernel at gated geometries — flip
+#: after the real-chip A/B (exp_packed.py) shows a win; until then the
+#: opt-in is $CHUNKY_BITS_PACKED_KERNEL=1
+_PACKED_DEFAULT = False
+
+
+def _packed_enabled() -> bool:
+    import os
+
+    v = os.environ.get("CHUNKY_BITS_PACKED_KERNEL")
+    if v is None:
+        return _PACKED_DEFAULT
+    return v.lower() not in ("0", "", "false")
+
+
+def apply_m2_bitmajor(m2, shards, *, interpret: bool = False,
+                      packed: bool | None = None):
     """Fused transform over an already-built bit-major int8 device matrix.
 
     The traceable core of ``apply_matrix_pallas``: usable inside
@@ -261,12 +277,18 @@ def apply_m2_bitmajor(m2, shards, *, interpret: bool = False):
     arrives as a device argument and shapes are static at trace time.
     ``m2`` is int8 [R*8, K*8] from ``bit_matrix_bitmajor``; ``shards`` is
     uint8 [B, K, S].  Raises ValueError when shapes don't fit the fast
-    path.
+    path.  ``packed`` selects the field-multiplexed kernel (None = the
+    process default when the geometry is gated; selection is static at
+    trace time).
     """
     r8, k8 = m2.shape
     r, k = r8 // 8, k8 // 8
     b, k2, s = shards.shape
     assert k2 == k
+    if packed is None:
+        packed = _packed_enabled() and packed_geometry_ok(r, k, s)
+    if packed:
+        return apply_m2_bitmajor_packed(m2, shards, interpret=interpret)
     tile = _pick_tile(s, k)
     if tile == 0 or r == 0:
         raise ValueError(f"shard size {s} not tileable for pallas path")

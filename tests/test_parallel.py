@@ -178,8 +178,13 @@ def test_packed_kernel_interpret_identity():
     )
 
     rng = np.random.default_rng(5)
+    # gate corners (d and p extremes) + interior geometries + decode-
+    # shaped rows; a 120-geometry sweep of the whole gated grid (d 1..15
+    # x p 1..8, encode + decode rows) passed as a one-off with the same
+    # oracle — this subset keeps the corners pinned in the suite
     for d, p, batch, s in [(10, 4, 2, 512), (10, 4, 3, 256), (3, 2, 2, 256),
-                           (15, 8, 2, 256), (8, 8, 2, 256)]:
+                           (15, 8, 2, 256), (8, 8, 2, 256), (1, 1, 2, 256),
+                           (15, 1, 2, 512), (1, 8, 3, 256), (12, 6, 4, 768)]:
         assert packed_geometry_ok(p, d, s)
         enc = matrix.build_encode_matrix(d, p)
         data = rng.integers(0, 256, (batch, d, s), dtype=np.uint8)
@@ -188,11 +193,56 @@ def test_packed_kernel_interpret_identity():
             m2, jnp.asarray(data), interpret=True))
         want = ErasureCoder(d, p, NumpyBackend()).encode_batch(data)
         assert np.array_equal(got, want), (d, p, batch, s)
+        if d >= 2 and p >= 2:
+            # decode-shaped rows: reconstruct r (= #erased, <= p) rows
+            erased = [0, d]
+            present = [i for i in range(d + p) if i not in erased][:d]
+            dec = matrix.decode_matrix(enc, present, erased)
+            full = np.concatenate([data, want], axis=1)
+            got = np.asarray(apply_m2_bitmajor_packed(
+                bitmajor_device_matrix(dec),
+                jnp.asarray(np.ascontiguousarray(full[:, np.array(present)])),
+                interpret=True))
+            assert np.array_equal(got, full[:, np.array(erased)]), (d, p)
 
     # outside the gate: p>8 (two weight tiles), d>15 (field overflow),
     # and lane-misaligned tile halves must all be refused
     for r, k, s in [(9, 10, 512), (4, 16, 512), (4, 10, 128)]:
         assert not packed_geometry_ok(r, k, s)
+
+
+def test_packed_kernel_env_selection(monkeypatch):
+    """$CHUNKY_BITS_PACKED_KERNEL=1 routes gated geometries through the
+    field-multiplexed kernel from the shared entry point (and therefore
+    from apply_matrix_pallas and every mesh impl) with identical bytes;
+    ungated geometries must keep falling back to the standard kernel."""
+    import jax.numpy as jnp
+
+    from chunky_bits_tpu.ops.pallas_kernels import (
+        apply_m2_bitmajor,
+        bitmajor_device_matrix,
+    )
+
+    monkeypatch.setenv("CHUNKY_BITS_PACKED_KERNEL", "1")
+    rng = np.random.default_rng(11)
+    calls = []
+    import chunky_bits_tpu.ops.pallas_kernels as pk
+    real_packed = pk.apply_m2_bitmajor_packed
+    monkeypatch.setattr(
+        pk, "apply_m2_bitmajor_packed",
+        lambda *a, **kw: calls.append(a[0].shape) or real_packed(*a, **kw))
+    # gated (d=10,p=4) and ungated (s=128 lane-misaligned halves)
+    for d, p, s in [(10, 4, 512), (10, 4, 128)]:
+        enc = matrix.build_encode_matrix(d, p)
+        data = rng.integers(0, 256, (2, d, s), dtype=np.uint8)
+        m2 = bitmajor_device_matrix(enc[d:])
+        got = np.asarray(apply_m2_bitmajor(m2, jnp.asarray(data),
+                                           interpret=True))
+        want = ErasureCoder(d, p, NumpyBackend()).encode_batch(data)
+        assert np.array_equal(got, want), (d, p, s)
+    # identical bytes from both kernels would mask broken routing: the
+    # packed path must have been taken exactly once (the gated call)
+    assert calls == [(32, 80)]
 
 
 def test_sharded_apply_pallas_impl_identity(eight_devices):
