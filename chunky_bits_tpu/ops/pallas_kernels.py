@@ -142,8 +142,8 @@ def _build_packed_kernel(r: int, k: int, tile_s: int, bblock: int,
             for b in range(1, 8):
                 plo = plo | (lo_bits[b * r:(b + 1) * r, :] << b)
                 phi = phi | (hi_bits[b * r:(b + 1) * r, :] << b)
-            out_ref[bi] = jnp.concatenate(
-                [plo, phi], axis=1).astype(jnp.uint8)
+            out_ref[bi, :, 0:h] = plo.astype(jnp.uint8)
+            out_ref[bi, :, h:tile_s] = phi.astype(jnp.uint8)
 
     def call(m2, data):
         batch, _k, s = data.shape
@@ -255,17 +255,20 @@ def _pick_tile(s: int, k: int, row_bytes: int = 0) -> int:
 
 #: default for the field-multiplexed kernel at gated geometries — flip
 #: after the real-chip A/B (exp_packed.py) shows a win; until then the
-#: opt-in is $CHUNKY_BITS_PACKED_KERNEL=1
+#: opt-in is $CHUNKY_BITS_TPU_PACKED_KERNEL=1
 _PACKED_DEFAULT = False
 
 
 def _packed_enabled() -> bool:
+    """Standard env-flag parsing (utils/aio.py::mmap_opted_out): unset
+    falls back to the process default; "", "0", "false", "no", "off"
+    mean off."""
     import os
 
-    v = os.environ.get("CHUNKY_BITS_PACKED_KERNEL")
+    v = os.environ.get("CHUNKY_BITS_TPU_PACKED_KERNEL")
     if v is None:
         return _PACKED_DEFAULT
-    return v.lower() not in ("0", "", "false")
+    return v.strip().lower() not in ("", "0", "false", "no", "off")
 
 
 def apply_m2_bitmajor(m2, shards, *, interpret: bool = False,

@@ -44,6 +44,7 @@ want = np.asarray(std_ref(m2, x[:4, :, :65536]))
 
 configs = [
     ("std", 32768, 2, _build_kernel(p, d, 32768, 2, False)),
+    ("packed", 16384, 2, _build_packed_kernel(p, d, 16384, 2, False)),
     ("packed", 32768, 2, _build_packed_kernel(p, d, 32768, 2, False)),
     ("packed", 65536, 2, _build_packed_kernel(p, d, 65536, 2, False)),
     ("packed", 32768, 4, _build_packed_kernel(p, d, 32768, 4, False)),
@@ -51,7 +52,13 @@ configs = [
 
 failed = False
 for name, tile, bblock, fn in configs:
-    got = np.asarray(fn(m2, x[:4, :, :65536]))
+    try:
+        got = np.asarray(fn(m2, x[:4, :, :65536]))
+    except Exception as err:  # e.g. VMEM overflow at the big tile
+        print(f"{name} tile={tile} bblock={bblock}: COMPILE/RUN FAIL "
+              f"({type(err).__name__})")
+        failed = True
+        continue
     if not np.array_equal(want, got):
         print(f"{name} tile={tile} bblock={bblock}: IDENTITY FAIL")
         failed = True

@@ -212,7 +212,7 @@ def test_packed_kernel_interpret_identity():
 
 
 def test_packed_kernel_env_selection(monkeypatch):
-    """$CHUNKY_BITS_PACKED_KERNEL=1 routes gated geometries through the
+    """$CHUNKY_BITS_TPU_PACKED_KERNEL=1 routes gated geometries through the
     field-multiplexed kernel from the shared entry point (and therefore
     from apply_matrix_pallas and every mesh impl) with identical bytes;
     ungated geometries must keep falling back to the standard kernel."""
@@ -223,7 +223,7 @@ def test_packed_kernel_env_selection(monkeypatch):
         bitmajor_device_matrix,
     )
 
-    monkeypatch.setenv("CHUNKY_BITS_PACKED_KERNEL", "1")
+    monkeypatch.setenv("CHUNKY_BITS_TPU_PACKED_KERNEL", "1")
     rng = np.random.default_rng(11)
     calls = []
     import chunky_bits_tpu.ops.pallas_kernels as pk
