@@ -22,26 +22,99 @@ import time
 import numpy as np
 
 
+# Last driver-captured device record (BENCH_r03.json): lets an outage
+# record distinguish "environment down" from "perf regression".
+_LAST_GOOD = {"round": 3, "encode_gibps": 54.66, "decode_gibps": 54.47}
+
+
+def _outage_record(metric: str) -> str:
+    """The structured line emitted when the tunnel never answers: keeps
+    the driver-parsed fields (metric/value/unit/vs_baseline) AND marks
+    the failure as an environment outage with the last authoritative
+    number, so a 0.0 here is never mistaken for a regression."""
+    return json.dumps({
+        "metric": metric,
+        "value": 0.0, "unit": "GiB/s", "vs_baseline": 0.0,
+        "error": "device init timeout (tpu tunnel unreachable)",
+        "tunnel_down": True,
+        "last_good": _LAST_GOOD,
+    })
+
+
+def _probe_device(timeout_s: float) -> str:
+    """PJRT init probe in a throwaway subprocess: when the tunnel is
+    down, jax.devices() blocks forever and cannot be interrupted
+    in-process, so the only safe pre-flight (and the only way retries
+    can exist at all) is a killable child.  On a healthy tunnel the
+    probe costs one extra init (~20-40 s) per bench run — accepted:
+    a round's device record is worth more (VERDICT r4).
+
+    Returns "" on success, "timeout" on a hang, else the child's
+    stderr tail — a crash (broken install, PJRT abort) must surface as
+    itself, not be recorded as a tunnel outage."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+    except subprocess.TimeoutExpired:
+        return "timeout"
+    if r.returncode == 0:
+        return ""
+    return "probe rc=%d: %s" % (
+        r.returncode, r.stderr.decode(errors="replace")[-500:])
+
+
 def _device_init_watchdog(metric: str):
-    """Device-init watchdog: the tunneled dev chip's PJRT client blocks
-    indefinitely when the tunnel endpoint is down (observed round 4:
-    multi-hour outage; even jax.devices() hangs).  Emit a parseable
-    error line instead of hanging the driver.  600 s comfortably covers
-    a cold first compile (~40 s).  Returns the Event the caller must
-    ``set()`` once the device has answered (first compile/dispatch
+    """Device-init guard: the tunneled dev chip's PJRT client blocks
+    indefinitely when the tunnel endpoint is down (observed rounds 3-4:
+    multi-hour outages; even jax.devices() hangs).
+
+    Two layers: (1) bounded subprocess probes with backoff — a
+    transient blip costs a retry, not the round's device record;
+    (2) the in-process backstop watchdog, because the tunnel can die
+    between a green probe and the main process's own init.  Both exits
+    emit the structured outage record.  Returns the Event the caller
+    must ``set()`` once the device has answered (first compile/dispatch
     done); every bench path that can touch a device must arm this."""
     import os
     import threading
+
+    # Bench owns outage handling: the library's 120s degrade-to-CPU
+    # (ops/jax_backend.py) would silently record CPU throughput as the
+    # device metric, so disable it here (unless the operator set an
+    # explicit bound) and let THIS watchdog's structured record fire.
+    from chunky_bits_tpu.ops.jax_backend import DEVICE_INIT_TIMEOUT_ENV
+
+    os.environ.setdefault(DEVICE_INIT_TIMEOUT_ENV, "0")
+
+    fail = ""
+    for attempt in range(3):
+        fail = _probe_device(180)
+        if not fail:
+            break
+        if fail != "timeout":
+            # a crashing child is a deterministic code/env defect, not a
+            # transient tunnel outage — surface it now, don't backoff
+            print(json.dumps({
+                "metric": metric, "value": 0.0, "unit": "GiB/s",
+                "vs_baseline": 0.0, "error": fail}), flush=True)
+            sys.exit(3)
+        if attempt < 2:
+            delay = 20 * (attempt + 1)
+            print(f"# device probe {attempt + 1}/3 timed out; retrying "
+                  f"in {delay}s", file=sys.stderr, flush=True)
+            time.sleep(delay)
+    else:
+        print(_outage_record(metric), flush=True)
+        sys.exit(3)
 
     ready = threading.Event()
 
     def watchdog() -> None:
         if not ready.wait(600):
-            print(json.dumps({
-                "metric": metric,
-                "value": 0.0, "unit": "GiB/s", "vs_baseline": 0.0,
-                "error": "device init timeout (tpu tunnel unreachable)",
-            }), flush=True)
+            print(_outage_record(metric), flush=True)
             os._exit(3)
 
     threading.Thread(target=watchdog, daemon=True).start()
@@ -74,6 +147,8 @@ def marginal_seconds(body_fn, x, iters: int) -> float:
     so A/B numbers from the two scripts stay comparable."""
     import jax
     import jax.numpy as jnp
+
+    iters = max(2, iters)  # n1 == n2 at iters=1 -> zero-division below
 
     def make(n):
         def loop(x):

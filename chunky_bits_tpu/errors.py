@@ -74,6 +74,14 @@ class ErasureError(ChunkyBitsError):
     """Erasure-codec level failure (bad geometry, too many erasures)."""
 
 
+class DeviceInitTimeout(ErasureError):
+    """PJRT device init exceeded the bounded wait (tunnel/driver down).
+
+    Raised instead of letting ``jax.devices()`` block forever; backend
+    resolution catches it and degrades to the native CPU codec so
+    ``backend: jax`` in cluster.yaml never hangs a ``cp``."""
+
+
 class ClusterError(ChunkyBitsError):
     """Cluster-level failure (src/error.rs:167-192)."""
 

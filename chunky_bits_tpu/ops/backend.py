@@ -77,6 +77,39 @@ def register_backend(backend: ErasureBackend) -> None:
         _REGISTRY[backend.name] = backend
 
 
+def _build_device_backend(name: str, build, what: str) -> ErasureBackend:
+    """Construct a device backend; on a device-init timeout degrade
+    ``backend: jax`` to the native CPU codec with a loud warning instead
+    of hanging the operation (the tunneled chip's PJRT init blocks
+    forever when the endpoint is down — init-time outages only; see
+    jax_backend.await_device_init).  The caller registers a degraded
+    instance under the *requested* name so one process pays the timeout
+    at most once per spec.  Other failures keep their ErasureError
+    contract."""
+    from chunky_bits_tpu.errors import DeviceInitTimeout
+
+    try:
+        return build()
+    except DeviceInitTimeout as err:
+        import warnings
+
+        warnings.warn(
+            f"backend {name!r} unavailable: {err}; DEGRADED to the "
+            f"native CPU codec for the rest of this process (output "
+            f"stays byte-identical, throughput drops to the host's CPU "
+            f"band)", RuntimeWarning, stacklevel=4)
+        try:
+            from chunky_bits_tpu.ops.cpu_backend import NativeBackend
+
+            return NativeBackend()
+        except Exception:
+            return NumpyBackend()
+    except ErasureError:
+        raise
+    except Exception as err:  # e.g. no usable jax device/platform
+        raise ErasureError(f"{what} unavailable: {err}") from err
+
+
 def get_backend(name: Optional[str] = None) -> ErasureBackend:
     """Resolve a backend by name, building it lazily.
 
@@ -112,25 +145,26 @@ def get_backend(name: Optional[str] = None) -> ErasureBackend:
     elif name == "jax":
         from chunky_bits_tpu.ops.jax_backend import JaxBackend
 
-        try:
-            backend = JaxBackend()
-        except ErasureError:
-            raise
-        except Exception as err:  # e.g. no usable jax device/platform
-            raise ErasureError(
-                f"jax erasure backend unavailable: {err}") from err
+        backend = _build_device_backend(name, JaxBackend,
+                                        "jax erasure backend")
+        if backend.name != "jax":  # degraded: cache under requested name
+            with _REGISTRY_LOCK:
+                _REGISTRY[name] = backend
+            return backend
     elif name.startswith("jax:"):
         # mesh-sharded device backend, e.g. "jax:dp4,sp2" / "jax:tp4"
         # (parallel/backend.py)
         from chunky_bits_tpu.parallel.backend import MeshJaxBackend
 
-        try:
-            backend = MeshJaxBackend(name[len("jax:"):])
-        except ErasureError:
-            raise
-        except Exception as err:
-            raise ErasureError(
-                f"mesh jax backend {name!r} unavailable: {err}") from err
+        backend = _build_device_backend(
+            name, lambda: MeshJaxBackend(name[len("jax:"):]),
+            f"mesh jax backend {name!r}")
+        if not backend.name.startswith("jax"):
+            # degraded: cache under the requested spelling only — never
+            # clobber the registry's own "native"/"numpy" entries
+            with _REGISTRY_LOCK:
+                _REGISTRY[name] = backend
+            return backend
         # Register the canonical resolved name AND the requested spelling
         # so repeat lookups under either hit the cache.
         register_backend(backend)

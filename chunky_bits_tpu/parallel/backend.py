@@ -65,6 +65,9 @@ class MeshJaxBackend(ErasureBackend):
         from chunky_bits_tpu.parallel import mesh as mesh_mod
 
         axes = parse_mesh_spec(spec)
+        from chunky_bits_tpu.ops.jax_backend import await_device_init
+
+        await_device_init()
         import jax
 
         n = len(jax.devices())
