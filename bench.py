@@ -81,13 +81,14 @@ def _device_init_watchdog(metric: str):
     import os
     import threading
 
-    # Bench owns outage handling: the library's 120s degrade-to-CPU
+    # Bench owns outage handling: the library's bounded degrade-to-CPU
     # (ops/jax_backend.py) would silently record CPU throughput as the
-    # device metric, so disable it here (unless the operator set an
-    # explicit bound) and let THIS watchdog's structured record fire.
+    # device metric, so force it off — even an inherited env value
+    # (e.g. the SKILL.md e2e recipe's 15s) must not re-enable it —
+    # and let THIS watchdog's structured record fire instead.
     from chunky_bits_tpu.ops.jax_backend import DEVICE_INIT_TIMEOUT_ENV
 
-    os.environ.setdefault(DEVICE_INIT_TIMEOUT_ENV, "0")
+    os.environ[DEVICE_INIT_TIMEOUT_ENV] = "0"
 
     fail = ""
     for attempt in range(3):

@@ -1,0 +1,194 @@
+"""Device-side batched SHA-256 over shard rows.
+
+The reference hashes every shard on the host CPU (reference:
+src/file/file_part.rs:185 via the ``sha2`` crate, one core per shard).
+On this system the host hash is the measured end-to-end ceiling
+(BASELINE.md config 2: ~0.7 GiB/s fused encode+hash on a 1-core host)
+while the accelerator encodes at ~54 GiB/s and then idles — so shard
+hashing is the one integrity op worth moving on-device.
+
+TPU-first shape: SHA-256 is strictly sequential along its own message,
+but every shard row is independent, so the batch axis [N = B*(d+p)]
+fills the VPU's lanes while a ``fori_loop`` walks the 64-byte blocks.
+Everything is 32-bit integer adds/rotates/xors — native VPU ops; no MXU
+involvement, so on a mesh it can run concurrently with GF matmuls.
+
+Layout: rows ``u8[N, S]`` are repacked once to big-endian ``u32[N, W]``
+words (vectorized shifts), then the compression loop keeps the running
+digest as ``u32[N, 8]``.  The schedule expansion, the 64 rounds, and
+the block walk are all ``fori_loop``s — small loop bodies keep the
+graph (and compile time) flat in S, and dodge a superlinear
+compile/execute blowup this jax build's CPU backend hits on big
+unrolled integer bodies (see ``compress``).
+
+Correctness: digests are byte-identical to hashlib/SHA-NI for every row
+length (FIPS 180-4 padding included) — see tests/test_sha256_jax.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# FIPS 180-4 round constants
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5,
+    0x3956c25b, 0x59f111f1, 0x923f82a4, 0xab1c5ed5,
+    0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174,
+    0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7,
+    0xc6e00bf3, 0xd5a79147, 0x06ca6351, 0x14292967,
+    0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3,
+    0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5,
+    0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f, 0x682e6ff3,
+    0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+], dtype=np.uint32)
+
+_H0 = np.array([
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+], dtype=np.uint32)
+
+
+def _pad_tail(row_bytes: int) -> np.ndarray:
+    """The FIPS 180-4 suffix appended to every (equal-length) row:
+    0x80, zeros to a 64-byte boundary, then the bit length as a
+    big-endian u64.  Identical for all rows, so it is built once on the
+    host and broadcast."""
+    rem = (row_bytes + 9) % 64
+    zeros = (64 - rem) % 64
+    tail = bytearray()
+    tail.append(0x80)
+    tail.extend(b"\x00" * zeros)
+    tail.extend((row_bytes * 8).to_bytes(8, "big"))
+    return np.frombuffer(bytes(tail), dtype=np.uint8)
+
+
+def _split_tail(rows: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Split ``u8[N, S]`` into the 64-aligned head (a zero-copy view)
+    and the final block(s): the unaligned remainder plus the FIPS tail,
+    assembled on the host (<= 128 bytes/row).  The device then only
+    ever sees 64-aligned buffers — no odd-width device concatenate
+    (which this jax build's CPU backend miscompiles into a spin; the
+    head also avoids a whole-row device-side copy)."""
+    n, s = rows.shape
+    aligned = s - (s % 64)
+    tail = _pad_tail(s)
+    last = np.empty((n, s - aligned + tail.size), dtype=np.uint8)
+    last[:, :s - aligned] = rows[:, aligned:]
+    last[:, s - aligned:] = tail
+    return rows[:, :aligned], last
+
+
+def _rotr(x, r: int):
+    return (x >> np.uint32(r)) | (x << np.uint32(32 - r))
+
+
+def _to_words(jnp, buf):
+    """``u8[N, 64k] -> u32[N, 16k]`` big-endian words."""
+    b = buf.reshape(buf.shape[0], -1, 4).astype(jnp.uint32)
+    return ((b[:, :, 0] << 24) | (b[:, :, 1] << 16)
+            | (b[:, :, 2] << 8) | b[:, :, 3])
+
+
+@functools.lru_cache(maxsize=None)
+def _build_sha256_fn(head_bytes: int, last_bytes: int):
+    """Jit-compiled ``(u8[N, head_bytes], u8[N, last_bytes]) ->
+    u8[N, 32]``.  ``head`` is the 64-aligned prefix of the rows;
+    ``last`` is the host-assembled remainder + FIPS tail (64 or 128
+    bytes).  One executable per (N, head, last) triple via ordinary jit
+    retrace; the compression graph itself is independent of S."""
+    import jax
+    import jax.numpy as jnp
+
+    k = jnp.asarray(_K)
+    h0 = jnp.asarray(_H0)
+
+    def compress(state, w16):
+        """One FIPS 180-4 block over u32[N, 16], rows vectorized.
+
+        Both phases are ``fori_loop``s, NOT unrolled: the unrolled
+        64-round body (~2000 straight-line int ops) sends this jax
+        build's CPU backend into a superlinear compile/execute blowup
+        (8 rounds 0.5 s, 32 rounds 3.4 s, 64 rounds never returns).
+        Loop bodies of ~25 ops keep compile trivial everywhere; the
+        batch axis still fills the VPU lanes."""
+        n = w16.shape[0]
+
+        def sched_step(t, w):
+            w15 = jax.lax.dynamic_slice(w, (0, t - 15), (n, 1))[:, 0]
+            w2 = jax.lax.dynamic_slice(w, (0, t - 2), (n, 1))[:, 0]
+            w16_ = jax.lax.dynamic_slice(w, (0, t - 16), (n, 1))[:, 0]
+            w7 = jax.lax.dynamic_slice(w, (0, t - 7), (n, 1))[:, 0]
+            s0 = (_rotr(w15, 7) ^ _rotr(w15, 18)
+                  ^ (w15 >> np.uint32(3)))
+            s1 = (_rotr(w2, 17) ^ _rotr(w2, 19)
+                  ^ (w2 >> np.uint32(10)))
+            return jax.lax.dynamic_update_slice(
+                w, (w16_ + s0 + w7 + s1)[:, None], (0, t))
+
+        w = jnp.concatenate(
+            [w16, jnp.zeros((n, 48), jnp.uint32)], axis=1)
+        w = jax.lax.fori_loop(16, 64, sched_step, w)
+
+        def round_step(t, vs):
+            a, b, c, d, e, f, g, h = [vs[:, j] for j in range(8)]
+            wt = jax.lax.dynamic_slice(w, (0, t), (n, 1))[:, 0]
+            s1 = (_rotr(e, 6) ^ _rotr(e, 11)
+                  ^ _rotr(e, 25))
+            ch = (e & f) ^ (~e & g)
+            t1 = h + s1 + ch + k[t] + wt
+            s0 = (_rotr(a, 2) ^ _rotr(a, 13)
+                  ^ _rotr(a, 22))
+            maj = (a & b) ^ (a & c) ^ (b & c)
+            return jnp.stack(
+                [t1 + s0 + maj, a, b, c, d + t1, e, f, g], axis=1)
+
+        vs = jax.lax.fori_loop(0, 64, round_step, state)
+        return state + vs
+
+    def sha256(head, last):
+        n = head.shape[0]
+        # Word-space concat of two 64-aligned buffers, then ONE
+        # fori_loop over every block.  Keeping the compress inside the
+        # loop (rather than unrolling the tail blocks at top level)
+        # matters: this jax build's CPU runtime spins forever executing
+        # the unrolled variant (and the odd-width byte concat) — see
+        # tests/test_sha256_jax.py for the shape sweep that pins both.
+        words = jnp.concatenate(
+            [_to_words(jnp, head), _to_words(jnp, last)], axis=1)
+        init = jnp.broadcast_to(h0, (n, 8))
+
+        def block_step(i, state):
+            return compress(state, jax.lax.dynamic_slice(
+                words, (0, i * 16), (n, 16)))
+
+        state = jax.lax.fori_loop(
+            0, (head_bytes + last_bytes) // 64, block_step, init)
+        # big-endian digest bytes [N, 32]
+        out = jnp.stack([
+            (state >> np.uint32(s)).astype(jnp.uint8)
+            for s in (24, 16, 8, 0)], axis=2)
+        return out.reshape(n, 32)
+
+    return jax.jit(sha256)
+
+
+def sha256_rows_device(rows: np.ndarray):
+    """SHA-256 of each row of ``u8[N, S]`` on the default JAX device;
+    returns ``u8[N, 32]`` digests as a host array, byte-identical to hashlib."""
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+    if rows.ndim != 2:
+        raise ValueError(f"want u8[N, S], got shape {rows.shape}")
+    if rows.shape[0] == 0:
+        return np.empty((0, 32), dtype=np.uint8)
+    head, last = _split_tail(rows)
+    fn = _build_sha256_fn(head.shape[1], last.shape[1])
+    return np.asarray(fn(head, last))

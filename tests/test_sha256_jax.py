@@ -1,0 +1,75 @@
+"""Device-side batched SHA-256 (ops/sha256_jax.py) vs hashlib.
+
+The kernel exists to move shard hashing off the 1-core host (VERDICT r4
+item 2; the reference hashes on CPU, src/file/file_part.rs:185).  Its
+contract is byte-identity with hashlib for EVERY row length — FIPS
+180-4 padding included — because digests feed chunk names and verify.
+
+The shape sweep doubles as a regression net for two CPU-runtime
+pathologies this jax build exhibits (either one turns an encode into an
+infinite spin): odd-width u8 device concatenates, and unrolled
+~2000-op compression bodies.  The kernel dodges both (host-assembled
+tail block, fori_loop rounds); if a refactor reintroduces either, this
+file hangs rather than fails — pytest-timeout isn't available, so the
+sweep stays tiny to keep a hang obvious early in the run.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from chunky_bits_tpu.ops.sha256_jax import (_pad_tail, _split_tail,
+                                            sha256_rows_device)
+
+
+def _hashlib_rows(rows: np.ndarray) -> np.ndarray:
+    return np.stack([
+        np.frombuffer(hashlib.sha256(r.tobytes()).digest(), dtype=np.uint8)
+        for r in rows])
+
+
+@pytest.mark.parametrize("n,s", [
+    (1, 0),      # empty rows: digest of b""
+    (1, 1),      # sub-block, odd width
+    (2, 55),     # largest 1-block message
+    (3, 56),     # smallest 2-block padding spill
+    (2, 64),     # exactly one aligned block
+    (4, 100),    # aligned head + odd remainder
+    (2, 192),    # multi-block aligned
+    (3, 1000),   # multi-block odd
+])
+def test_identical_to_hashlib(n, s):
+    rows = np.random.default_rng(s).integers(0, 256, (n, s), dtype=np.uint8)
+    assert np.array_equal(sha256_rows_device(rows), _hashlib_rows(rows))
+
+
+def test_empty_batch():
+    out = sha256_rows_device(np.empty((0, 128), dtype=np.uint8))
+    assert out.shape == (0, 32)
+
+
+def test_rejects_non_2d():
+    with pytest.raises(ValueError):
+        sha256_rows_device(np.zeros((2, 3, 4), dtype=np.uint8))
+
+
+def test_pad_tail_lengths():
+    # padded length must always be the next 64 multiple of s + 9
+    for s in (0, 1, 54, 55, 56, 63, 64, 119, 120, 1 << 20):
+        tail = _pad_tail(s)
+        assert (s + tail.size) % 64 == 0
+        assert tail[0] == 0x80
+        assert int.from_bytes(tail[-8:].tobytes(), "big") == s * 8
+
+
+def test_split_tail_alignment():
+    rows = np.arange(2 * 100, dtype=np.uint8).reshape(2, 100)
+    head, last = _split_tail(rows)
+    assert head.shape[1] == 64 and head.shape[1] % 64 == 0
+    assert last.shape[1] % 64 == 0
+    # head must be a zero-copy view of the input
+    assert head.base is not None and np.shares_memory(head, rows)
+    # reassembled prefix equals the original row bytes
+    joined = np.concatenate([head, last], axis=1)
+    assert np.array_equal(joined[:, :100], rows)
