@@ -83,6 +83,10 @@ def build_parser() -> argparse.ArgumentParser:
                        help="Find all hashes that are not referenced")
     p.add_argument("--batch-size", type=int, default=100000)
     p.add_argument("-r", "--remove", action="store_true")
+    p.add_argument("--grace-seconds", type=float, default=60.0,
+                   help="skip chunk files younger than this (an in-flight"
+                        " write stages chunks before publishing the"
+                        " metadata that references them; 0 disables)")
     p.add_argument("source", nargs="+",
                    help="cluster/file-ref locations that define liveness")
     p.add_argument("hashes", nargs="*", default=[],
@@ -303,7 +307,18 @@ async def _read_all(reader: aio.AsyncByteReader) -> bytes:
 
 async def find_unused_hashes(config, args) -> None:
     """GC: list hash files under local dirs, subtract hashes referenced by
-    the sources, print/remove the orphans; batched (main.rs:329-435)."""
+    the sources, print/remove the orphans; batched (main.rs:329-435).
+
+    Safe against concurrent ingest where the reference is not: a ``cp``
+    stages chunk files BEFORE publishing the metadata that references
+    them, so a racing GC would list the new chunk, find no reference,
+    and delete it out from under the imminent publish.  Chunk files
+    younger than ``--grace-seconds`` (measured against GC start) are
+    therefore never candidates; the reference runs the same scan with no
+    such guard (main.rs:329-435).  tests/test_gc_race.py interleaves
+    GC batches with live writes to pin the guarantee."""
+    import time as _time
+
     sources = [ClusterLocation.parse(s) for s in args.source]
     for s in sources:
         if s.kind not in ("cluster", "file_ref"):
@@ -312,11 +327,25 @@ async def find_unused_hashes(config, args) -> None:
     for h in hash_dirs:
         if h.kind != "other" or not h.location.is_local():
             raise ChunkyBitsError(f"Unsupported hashes location: {h}")
+    cutoff = _time.time() - args.grace_seconds
+
+    async def _age_of(path: str) -> str:
+        """``"old"`` (a GC candidate), ``"fresh"`` (inside the grace
+        window — an in-flight write may be about to reference it), or
+        ``"gone"`` (vanished mid-scan).  stat runs off-loop like the
+        listing's own metadata calls."""
+        if args.grace_seconds <= 0:
+            return "old"
+        try:
+            st = await asyncio.to_thread(os.stat, path)
+        except OSError:
+            return "gone"
+        return "old" if st.st_mtime < cutoff else "fresh"
 
     async def hash_files():
         for hash_dir in hash_dirs:
             async for entry in hash_dir.list_files_recursive(config):
-                if entry.is_file():
+                if entry.is_file() and await _age_of(entry.path) == "old":
                     yield entry.path
 
     files_iter = hash_files()
@@ -342,11 +371,29 @@ async def find_unused_hashes(config, args) -> None:
             async for hash_ in source.get_hashes_rec(config):
                 existing.pop(str(hash_), None)
         for hash_str, paths in existing.items():
-            print(hash_str)
-            if args.remove:
-                for path in paths:
-                    print(f"Removing {path}", file=sys.stderr)
-                    await Location.local(path).delete()
+            if not args.remove:
+                print(hash_str)
+                continue
+            removed = False
+            for path in paths:
+                # Re-check age at the last moment: a concurrent ingest
+                # can re-write a listed orphan (same content hash =>
+                # same path) between the batch scan and this delete; a
+                # fresh mtime means someone wants it again.
+                age = await _age_of(path)
+                if age == "gone":
+                    continue  # someone else removed it — goal achieved
+                if age == "fresh":
+                    print(f"Skipping recently re-written {path}",
+                          file=sys.stderr)
+                    continue
+                print(f"Removing {path}", file=sys.stderr)
+                await Location.local(path).delete()
+                removed = True
+            if removed:
+                # in remove mode the stdout line means "collected", so
+                # a hash whose every path was spared is not printed
+                print(hash_str)
 
 
 def main(argv=None) -> int:

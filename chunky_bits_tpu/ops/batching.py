@@ -26,6 +26,18 @@ from chunky_bits_tpu.errors import ErasureError
 from chunky_bits_tpu.ops.backend import get_coder
 
 
+class _GroupItemError:
+    """Per-item failure marker in a ``_run_group`` result list: lets a
+    group deliver a mix of results and exceptions, so one bad batch in
+    an UNMERGED group fails only its own waiter (a merged dispatch has
+    no such boundary — every contributing waiter shares its fate)."""
+
+    __slots__ = ("err",)
+
+    def __init__(self, err: BaseException):
+        self.err = err
+
+
 class _CoalescingBatcher:
     """Group concurrent requests by key and dispatch each group once.
 
@@ -88,7 +100,10 @@ class _CoalescingBatcher:
         else:
             for (_, _, fut), res in zip(group, results):
                 if not fut.done():
-                    fut.set_result(res)
+                    if isinstance(res, _GroupItemError):
+                        fut.set_exception(res.err)
+                    else:
+                        fut.set_result(res)
 
     async def aclose(self) -> None:
         """Drain: await the pending collection task and every in-flight
@@ -223,8 +238,17 @@ class EncodeHashBatcher(_CoalescingBatcher):
         # 1-core host) — run their batches back-to-back unmerged.
         merge = getattr(coder.backend, "prefers_merged_batches", False)
         if not merge or len(batches) == 1:
-            self.dispatches += len(batches)
-            return [self._encode(coder, b) for b in batches]
+            # Unmerged batches are independent dispatches that happen to
+            # share a drain tick: a failure belongs to its own waiter
+            # only, and later batches in the group must still encode.
+            out = []
+            for b in batches:
+                self.dispatches += 1
+                try:
+                    out.append(self._encode(coder, b))
+                except Exception as err:
+                    out.append(_GroupItemError(err))
+            return out
         self.dispatches += 1
         merged = np.concatenate(batches, axis=0)
         parity, digests = self._encode(coder, merged)

@@ -312,3 +312,106 @@ def test_batcher_caller_cancellation():
                 assert np.array_equal(got[i], want[i])
 
     asyncio.run(main())
+
+
+def test_merged_group_failure_reaches_exactly_its_waiters():
+    """VERDICT r4 item 6: when sub-batches from concurrent writes merge
+    into ONE dispatch and that dispatch fails, the failure must reach
+    every contributing waiter — and only them: a concurrently pending
+    group with a different key still encodes, and the next submission
+    on the failed key works (no poisoned batcher state)."""
+    from chunky_bits_tpu.ops.backend import register_backend
+    from chunky_bits_tpu.ops.batching import EncodeHashBatcher
+
+    d, p = 4, 2
+    rng = np.random.default_rng(5)
+    coder = ErasureCoder(d, p, NumpyBackend())
+
+    class MergingNumpy(NumpyBackend):
+        name = "numpy-merging-fail"
+        prefers_merged_batches = True
+
+    class PoisonBatcher(EncodeHashBatcher):
+        """Fails any dispatch whose batch contains the poison marker."""
+
+        def _encode(self, coder, stacked):
+            if (stacked[:, 0, :2] == 0xEE).all(axis=1).any():
+                raise RuntimeError("injected codec failure")
+            return super()._encode(coder, stacked)
+
+    poisoned = rng.integers(0, 256, (1, d, 512), dtype=np.uint8)
+    poisoned[0, 0, :2] = 0xEE
+    clean_same_key = [rng.integers(0, 256, (1, d, 512), dtype=np.uint8)
+                      for _ in range(3)]
+    other_key = [rng.integers(0, 256, (2, d, 1024), dtype=np.uint8)
+                 for _ in range(2)]
+
+    async def main():
+        batcher = PoisonBatcher(backend="numpy-merging-fail")
+        results = await asyncio.gather(
+            batcher.encode_hash(d, p, poisoned),
+            *[batcher.encode_hash(d, p, b) for b in clean_same_key],
+            *[batcher.encode_hash(d, p, b) for b in other_key],
+            return_exceptions=True)
+        # the poisoned merged group: every contributing waiter fails
+        for r in results[:4]:
+            assert isinstance(r, RuntimeError), r
+        # the other key's group is untouched
+        for stacked, r in zip(other_key, results[4:]):
+            assert not isinstance(r, BaseException), r
+            want_par, want_dig = coder.encode_hash_batch(stacked)
+            assert np.array_equal(r[0], want_par)
+            assert np.array_equal(r[1], want_dig)
+        # and the key itself is not poisoned: the next clean submission
+        # on the same (d, p, size) encodes fine
+        parity, digests = await batcher.encode_hash(
+            d, p, clean_same_key[0])
+        want_par, want_dig = coder.encode_hash_batch(clean_same_key[0])
+        assert np.array_equal(parity, want_par)
+        assert np.array_equal(digests, want_dig)
+
+    from chunky_bits_tpu.ops import backend as backend_mod
+
+    register_backend(MergingNumpy())
+    try:
+        asyncio.run(main())
+    finally:
+        backend_mod._REGISTRY.pop("numpy-merging-fail", None)
+
+
+def test_unmerged_group_failure_is_isolated_per_batch():
+    """On CPU backends the group's batches dispatch unmerged, so a
+    failing batch must fail ONLY its own waiter; co-grouped clean
+    batches — including ones dispatched after the failure — succeed."""
+    from chunky_bits_tpu.ops.batching import EncodeHashBatcher
+
+    d, p = 4, 2
+    rng = np.random.default_rng(6)
+    coder = ErasureCoder(d, p, NumpyBackend())
+
+    class PoisonBatcher(EncodeHashBatcher):
+        def _encode(self, coder, stacked):
+            if (stacked[:, 0, :2] == 0xEE).all(axis=1).any():
+                raise RuntimeError("injected codec failure")
+            return super()._encode(coder, stacked)
+
+    batches = [rng.integers(0, 256, (1, d, 512), dtype=np.uint8)
+               for _ in range(4)]
+    batches[1][0, 0, :2] = 0xEE  # second in the group fails
+
+    async def main():
+        batcher = PoisonBatcher(backend="numpy")
+        results = await asyncio.gather(
+            *[batcher.encode_hash(d, p, b) for b in batches],
+            return_exceptions=True)
+        assert isinstance(results[1], RuntimeError)
+        for i in (0, 2, 3):
+            assert not isinstance(results[i], BaseException), results[i]
+            want_par, want_dig = coder.encode_hash_batch(batches[i])
+            assert np.array_equal(results[i][0], want_par)
+            assert np.array_equal(results[i][1], want_dig)
+        # all four were real dispatches (unmerged), one group
+        assert batcher.dispatches == 4
+        assert batcher.groups == 1
+
+    asyncio.run(main())

@@ -196,6 +196,10 @@ def test_find_unused_hashes(cluster_yaml, tmp_path):
     orphan_hash = "sha256-" + hashlib.sha256(b"orphan").hexdigest()
     orphan_path = tmp_path / "disk0" / orphan_hash
     orphan_path.write_bytes(b"orphan")
+    # age it past the GC grace window (fresh files are shielded —
+    # they look like an in-flight write's staged chunks)
+    old = os.stat(orphan_path).st_mtime - 3600
+    os.utime(orphan_path, (old, old))
     disks = [str(tmp_path / f"disk{i}") for i in range(5)]
     out = run_cli("find-unused-hashes", f"{cluster_yaml}#.",
                   "--", *disks)
