@@ -226,17 +226,22 @@ def main() -> None:
     def _marginal_seconds(body_fn, x) -> float:
         return marginal_seconds(body_fn, x, iters)
 
-    _xor_cost_cache: dict[int, float] = {}
+    _xor_cost_cache: dict[tuple, float] = {}
 
     def sustained_gibps(apply_fn, x) -> float:
-        if 0 not in _xor_cost_cache:
-            _xor_cost_cache[0] = _marginal_seconds(lambda y: y, x)
-        xor_cost = _xor_cost_cache[0]
+        """Marginal throughput of ``apply_fn`` over ``x[B, K, S]`` with
+        the XOR-loop carrier cost (measured once per input shape)
+        subtracted; 0.0 when either measurement is invalid."""
+        shape = tuple(x.shape)
+        if shape not in _xor_cost_cache:
+            _xor_cost_cache[shape] = _marginal_seconds(lambda y: y, x)
+        xor_cost = _xor_cost_cache[shape]
         total = _marginal_seconds(apply_fn, x)
         if total < 0 or xor_cost < 0 or total <= xor_cost:
             return 0.0
         kernel = total - xor_cost
-        return batch * d * size / kernel / (1 << 30)
+        b, k, s = shape
+        return b * k * s / kernel / (1 << 30)
 
     x = jnp.asarray(data)
 
@@ -256,6 +261,38 @@ def main() -> None:
     # decode-with-4-erasures: x [B, 10, S] stands in for the survivors
     decode_gibps = sustained_gibps(device_apply(dec_rows), x)
 
+    # Wide geometry d=16 p=8: the occupancy model
+    # (pallas_kernels.py:34-43) says d=10 p=4's [K8, R8] = [80, 32]
+    # weight tile caps MXU cell occupancy at 15.6% and predicts the
+    # fix is geometry, not kernel: K8 = 128 and (with the kernel's two
+    # parts per grid cell) 2*R8 = 128 fill the array -> ~3.2x the
+    # per-cell-streaming throughput if the model is right.  Measured
+    # here on-chip to confirm or correct it (accel only: the CPU
+    # fallback would double an already-slow run for no signal).
+    wide_gibps = None  # None = not attempted/invalid -> key omitted
+    if on_accel:
+        d16, p8, b16 = 16, 8, 64
+        enc16 = matrix.build_encode_matrix(d16, p8)
+        data16 = rng.integers(0, 256, (b16, d16, size), dtype=np.uint8)
+        small16 = data16[:1, :, :8192]
+        want16 = ErasureCoder(d16, p8, NumpyBackend()).encode_batch(
+            small16)
+        got16 = backend.apply_matrix(enc16[d16:], small16)
+        if not np.array_equal(want16, got16):
+            print("# wide-geometry byte-identity FAILED; skipping",
+                  file=sys.stderr)
+        else:
+            from chunky_bits_tpu.ops.pallas_kernels import \
+                apply_matrix_pallas
+
+            rows16 = enc16[d16:]
+            # the 1 GiB transfer happens only after the gate passed
+            x16 = jnp.asarray(data16)
+            wide_gibps = sustained_gibps(
+                lambda y: apply_matrix_pallas(rows16, y), x16) or None
+            del x16  # free HBM before the e2e dispatch measurement
+        del data16
+
     # end-to-end dispatch rate (includes per-call host overhead)
     apply_fn = device_apply(parity_rows)
     f1 = jax.jit(lambda x: apply_fn(x).astype(jnp.uint32).sum())
@@ -265,12 +302,17 @@ def main() -> None:
     _ = [int(v) for v in vals]
     e2e = 4 * batch * d * size / (time.time() - t0) / (1 << 30)
 
+    if wide_gibps is not None and encode_gibps > 0:
+        wide_note = (f" | wide d16p8 encode: {wide_gibps:.1f} GiB/s "
+                     f"({wide_gibps / encode_gibps:.2f}x vs d10p4)")
+    else:
+        wide_note = ""
     print(
         f"# d={d} p={p} chunk=1MiB batch={batch} device="
         f"{jax.devices()[0]}\n"
         f"# encode sustained: {encode_gibps:.1f} GiB/s | decode(4 erasures)"
         f" sustained: {decode_gibps:.1f} GiB/s | e2e dispatch: "
-        f"{e2e:.1f} GiB/s",
+        f"{e2e:.1f} GiB/s{wide_note}",
         file=sys.stderr,
     )
     # if the loop measurement refused to report (hoist suspicion), fall
@@ -283,6 +325,10 @@ def main() -> None:
         "vs_baseline": round(value / 5.0, 2),
         "decode_4_erasures_gibps": round(decode_gibps, 2),
         "e2e_dispatch_gibps": round(e2e, 2),
+        # omitted (not 0.0) when skipped or invalid, so a CPU-fallback
+        # run can't read as a wide-geometry perf collapse
+        **({"wide_encode_gibps_d16p8_b64": round(wide_gibps, 2)}
+           if wide_gibps is not None else {}),
     }))
 
 
@@ -538,21 +584,39 @@ def bench_small_objects(argv=()) -> None:
     """BASELINE.md config 4's compute core: many concurrent small-object
     encodes (d=8 p=3, 4 MiB objects => [1, 8, S] batches) coalescing
     through the shared EncodeHashBatcher.  Reports aggregate ingest-side
-    encode+hash throughput and the achieved coalescing factor."""
+    encode+hash throughput and the achieved coalescing factor.
+
+    ``--threads N`` caps the native engine's host threads ("native:N");
+    ``--sweep-threads 1,2,4,8`` runs the whole measurement once per N
+    and prints one JSON line each — THE one-command scaling harness for
+    the host-SHA row (run it on a multi-core host to turn BASELINE.md's
+    projected SHA scaling into data; on a 1-core host the same command
+    records the thread-contention overhead curve)."""
     import asyncio
     import os
 
-    from chunky_bits_tpu.ops.batching import EncodeHashBatcher
+    argv = list(argv)
 
-    # --threads N caps the native engine's host threads ("native:N");
-    # default uses every core, so the metric scales with the host
-    backend = None
-    if "--threads" in argv:
-        idx = list(argv).index("--threads") + 1
-        if idx >= len(argv):
-            print("usage: bench.py --config 4 --threads N", file=sys.stderr)
-            sys.exit(2)
-        backend = "native:" + argv[idx]
+    def flag_val(name):
+        if name in argv:
+            idx = argv.index(name) + 1
+            if idx >= len(argv):
+                print(f"usage: bench.py --config 4 [{name} N[,N...]]",
+                      file=sys.stderr)
+                sys.exit(2)
+            return argv[idx]
+        return None
+
+    threads = flag_val("--threads")
+    sweep = flag_val("--sweep-threads")
+    if threads and sweep:
+        print("--threads and --sweep-threads conflict; pick one",
+              file=sys.stderr)
+        sys.exit(2)
+    specs = ([f"native:{n}" for n in sweep.split(",")] if sweep
+             else [f"native:{threads}" if threads else None])
+
+    from chunky_bits_tpu.ops.batching import EncodeHashBatcher
 
     d, p = 8, 3
     obj_bytes = 4 << 20
@@ -562,9 +626,9 @@ def bench_small_objects(argv=()) -> None:
     objs = [rng.integers(0, 256, (1, d, size), dtype=np.uint8)
             for _ in range(n_objects)]
     ready = _arm_if_device_backend(
-        backend, "bulk_ingest_encode_hash_gibps_d8p3_4mib_objs")
+        specs[0], "bulk_ingest_encode_hash_gibps_d8p3_4mib_objs")
 
-    async def run() -> float:
+    async def run(backend) -> float:
         batcher = EncodeHashBatcher(backend=backend)
         sem = asyncio.Semaphore(16)  # gateway-like request concurrency
 
@@ -588,13 +652,16 @@ def bench_small_objects(argv=()) -> None:
               f"host-side and scales with cores)", file=sys.stderr)
         return (n_objects - 1) * obj_bytes / dt / (1 << 30)
 
-    gib = asyncio.run(run())
-    print(json.dumps({
-        "metric": "bulk_ingest_encode_hash_gibps_d8p3_4mib_objs"
-                  + (f"_{backend.replace(':', '')}" if backend else ""),
-        "value": round(gib, 2), "unit": "GiB/s",
-        "vs_baseline": round(gib / 5.0, 2),
-    }))
+    for backend in specs:
+        gib = asyncio.run(run(backend))
+        print(json.dumps({
+            "metric": "bulk_ingest_encode_hash_gibps_d8p3_4mib_objs"
+                      + (f"_{backend.replace(':', '')}" if backend
+                         else ""),
+            "value": round(gib, 2), "unit": "GiB/s",
+            "vs_baseline": round(gib / 5.0, 2),
+            **({"host_cores": os.cpu_count()} if sweep else {}),
+        }))
 
 
 if __name__ == "__main__":
