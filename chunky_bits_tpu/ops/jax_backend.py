@@ -178,6 +178,11 @@ class JaxBackend(ErasureBackend):
         await_device_init()
         jax, _ = _ensure_jax()
         self._m2_cache: OrderedDict[bytes, object] = OrderedDict()
+        self._fused_cache: OrderedDict[tuple, object] = OrderedDict()
+        #: sticky off-switch for the device-SHA path after a failure
+        #: (mirrors the _on_tpu pallas fallback: a failing path must not
+        #: re-pay trace/compile/fail on every subsequent dispatch)
+        self._device_sha_ok = True
         self._lock = threading.Lock()
         # 128-aligned shard sizes on a TPU take the fused Pallas kernel
         # (ops/pallas_kernels.py — a TPU-only Mosaic kernel); everything
@@ -234,7 +239,7 @@ class JaxBackend(ErasureBackend):
                                       shards, block, on_block)
 
     def _pipelined_blocks(self, dispatch, shards: np.ndarray,
-                          block: int, on_block=None) -> np.ndarray:
+                          block: int, on_block=None):
         """Run ``dispatch`` over batch blocks with H2D/compute overlap:
         jax dispatch is asynchronous, so issuing block N+1's device_put
         and kernel before materializing block N's result lets the next
@@ -243,11 +248,20 @@ class JaxBackend(ErasureBackend):
         classic double buffer.  ``on_block(lo, arr)`` fires on the main
         thread as each output block materializes, so callers can overlap
         host post-processing (shard hashing) with the remaining device
-        work."""
+        work.  ``dispatch`` may return one array or a tuple of arrays
+        (the fused encode+hash path); tuple outputs are concatenated
+        per element, and ``on_block`` must be None for them."""
         jax, _ = _ensure_jax()
+
+        def materialize(o):
+            if isinstance(o, tuple):
+                assert on_block is None
+                return tuple(np.asarray(a) for a in o)
+            return np.asarray(o)
+
         b = shards.shape[0]
         if b <= block:
-            out = np.asarray(dispatch(jax.device_put(shards)))
+            out = materialize(dispatch(jax.device_put(shards)))
             if on_block is not None:
                 on_block(0, out)
             return out
@@ -257,15 +271,18 @@ class JaxBackend(ErasureBackend):
             dev = jax.device_put(np.ascontiguousarray(shards[lo:lo + block]))
             pending.append(dispatch(dev))
             if len(pending) > 1:
-                arr = np.asarray(pending.pop(0))
+                arr = materialize(pending.pop(0))
                 if on_block is not None:
                     on_block(len(outs) * block, arr)
                 outs.append(arr)
         for o in pending:
-            arr = np.asarray(o)
+            arr = materialize(o)
             if on_block is not None:
                 on_block(len(outs) * block, arr)
             outs.append(arr)
+        if isinstance(outs[0], tuple):
+            return tuple(np.concatenate([o[i] for o in outs], axis=0)
+                         for i in range(len(outs[0])))
         return np.concatenate(outs, axis=0)
 
     #: the fused kernel keeps bits in VMEM, so its device footprint is just
@@ -283,6 +300,69 @@ class JaxBackend(ErasureBackend):
             lambda dev: apply_matrix_pallas(mat, dev), shards, block,
             on_block)
 
+    @staticmethod
+    def _device_sha_enabled() -> bool:
+        """Opt-in for hashing shards on the device inside the encode
+        dispatch ($CHUNKY_BITS_TPU_DEVICE_SHA=1) — default off until an
+        on-chip A/B (exp_devsha.py) shows it beating host SHA x cores.
+        Read at dispatch time, but jit caches bake the routing into
+        compiled executables, so set it before the first encode (same
+        caveat as the packed-kernel flag, PARITY.md)."""
+        import os
+
+        return os.environ.get("CHUNKY_BITS_TPU_DEVICE_SHA") == "1"
+
+    def _fused_encode_hash_fn(self, mat: np.ndarray, s: int,
+                              interpret: bool = False):
+        """Jitted ``u8[B, k, S] -> (parity u8[B, r, S],
+        digests u8[B, k+r, 32])`` — parity and ALL shard digests in one
+        device dispatch: bytes cross host->device once and only parity
+        + 32 B/row digests come back.  SHA runs on the VPU, the GF
+        matmul on the MXU; XLA overlaps them freely.  ``interpret``
+        runs the pallas kernel in interpret mode (CPU tests).  Cached
+        per (matrix, S, interpret) so repeat ingests reuse the compiled
+        executable instead of re-tracing every dispatch."""
+        key = (mat.tobytes(), mat.shape, s, interpret)
+        with self._lock:
+            cached = self._fused_cache.get(key)
+        if cached is not None:
+            return cached
+        jax, jnp = _ensure_jax()
+        from chunky_bits_tpu.ops.pallas_kernels import apply_matrix_pallas
+        from chunky_bits_tpu.ops.sha256_jax import make_sha256_aligned
+
+        sha = make_sha256_aligned(s)
+        r = mat.shape[0]
+
+        def fused(dev):
+            b, k, _ = dev.shape
+            parity = apply_matrix_pallas(mat, dev, interpret=interpret)
+            digests = sha(jnp.concatenate(
+                [dev, parity], axis=1).reshape(b * (k + r), s))
+            return parity, digests.reshape(b, k + r, 32)
+
+        fn = jax.jit(fused)
+        with self._lock:
+            self._fused_cache[key] = fn
+            while len(self._fused_cache) > self.max_cached_matrices:
+                self._fused_cache.popitem(last=False)
+        return fn
+
+    def _encode_and_hash_device(
+        self, mat: np.ndarray, shards: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """The all-on-device ingest: the shared double-buffered block
+        walk, each dispatch returning (parity, digests)."""
+        b, k, s = shards.shape
+        r = mat.shape[0]
+        fn = self._fused_encode_hash_fn(mat, s)
+        # resident per item: data + parity + the concatenated copy the
+        # SHA hashes over = 2*(k+r)*s bytes (vs k*s*2 on the plain
+        # parity path)
+        per_item = 2 * (k + r) * s
+        block = max(1, self.max_pallas_block_bytes // 2 // per_item)
+        return self._pipelined_blocks(fn, shards, block)
+
     def encode_and_hash(
         self, mat: np.ndarray, shards: np.ndarray
     ) -> tuple[np.ndarray, np.ndarray]:
@@ -292,7 +372,12 @@ class JaxBackend(ErasureBackend):
         (ops/backend.py) runs encode-then-hash strictly serially, leaving
         the host idle during device compute — the reference's CPU path is
         serial too (src/file/file_part.rs:161,185).  Output is identical
-        to the fused native engine's, bit for bit."""
+        to the fused native engine's, bit for bit.
+
+        With $CHUNKY_BITS_TPU_DEVICE_SHA=1 (and a 64-aligned shard size
+        on the pallas path) the digests are computed ON the device in
+        the same dispatch as the parity — the host's per-core SHA bound
+        drops out of the pipeline entirely."""
         from chunky_bits_tpu.ops.backend import _ingest_hash_pool, \
             _row_hasher
 
@@ -308,6 +393,18 @@ class JaxBackend(ErasureBackend):
             hash_rows(parity, parity_digests)
             return parity, np.concatenate(
                 [data_digests, parity_digests], axis=1)
+        if (self._device_sha_ok and self._device_sha_enabled()
+                and self._on_tpu and s % 128 == 0 and s >= 1024):
+            # same eligibility gate as the pallas parity path, so the
+            # fused dispatch never mixes kernels mid-batch
+            try:
+                return self._encode_and_hash_device(mat, shards)
+            except Exception as err:
+                import warnings
+
+                self._device_sha_ok = False
+                warnings.warn(
+                    f"device SHA path disabled after failure: {err}")
         pool = _ingest_hash_pool()
         futs = [pool.submit(hash_rows, shards, data_digests)]
         covered = np.zeros(b, dtype=bool)

@@ -98,19 +98,7 @@ def _to_words(jnp, buf):
             | (b[:, :, 2] << 8) | b[:, :, 3])
 
 
-@functools.lru_cache(maxsize=None)
-def _build_sha256_fn(head_bytes: int, last_bytes: int):
-    """Jit-compiled ``(u8[N, head_bytes], u8[N, last_bytes]) ->
-    u8[N, 32]``.  ``head`` is the 64-aligned prefix of the rows;
-    ``last`` is the host-assembled remainder + FIPS tail (64 or 128
-    bytes).  One executable per (N, head, last) triple via ordinary jit
-    retrace; the compression graph itself is independent of S."""
-    import jax
-    import jax.numpy as jnp
-
-    k = jnp.asarray(_K)
-    h0 = jnp.asarray(_H0)
-
+def _make_compress(jax, jnp, k):
     def compress(state, w16):
         """One FIPS 180-4 block over u32[N, 16], rows vectorized.
 
@@ -154,8 +142,75 @@ def _build_sha256_fn(head_bytes: int, last_bytes: int):
         vs = jax.lax.fori_loop(0, 64, round_step, state)
         return state + vs
 
+    return compress
+
+
+def _digest_bytes(jnp, state):
+    """``u32[N, 8] -> u8[N, 32]`` big-endian digest bytes."""
+    out = jnp.stack([
+        (state >> np.uint32(s)).astype(jnp.uint8)
+        for s in (24, 16, 8, 0)], axis=2)
+    return out.reshape(state.shape[0], 32)
+
+
+def _sha256_over_words(jax, jnp, words, nblocks: int, compress):
+    """Run ``compress`` over ``nblocks`` 16-word blocks of
+    ``u32[N, 16*nblocks]``; returns digest bytes ``u8[N, 32]``."""
+    n = words.shape[0]
+    init = jnp.broadcast_to(jnp.asarray(_H0), (n, 8))
+
+    def block_step(i, state):
+        return compress(state, jax.lax.dynamic_slice(
+            words, (0, i * 16), (n, 16)))
+
+    state = jax.lax.fori_loop(0, nblocks, block_step, init)
+    return _digest_bytes(jnp, state)
+
+
+def make_sha256_aligned(row_bytes: int):
+    """A TRACEABLE ``u8[N, row_bytes] -> u8[N, 32]`` for 64-aligned
+    ``row_bytes``, composable inside a larger jit (the fused
+    encode+hash path hashes rows that are already device-resident, so
+    no host-side tail assembly is possible there).  The FIPS tail for
+    equal 64-aligned rows is one constant 64-byte block, appended in
+    word space."""
+    if row_bytes % 64 != 0:
+        raise ValueError(f"row_bytes must be 64-aligned, got {row_bytes}")
+    import jax
+    import jax.numpy as jnp
+
+    tail = _pad_tail(row_bytes)
+    assert tail.size == 64
+    tail_words_host = (
+        tail.reshape(16, 4).astype(np.uint32) @
+        np.array([1 << 24, 1 << 16, 1 << 8, 1], dtype=np.uint32))
+    compress = _make_compress(jax, jnp, jnp.asarray(_K))
+
+    def fn(rows):
+        n = rows.shape[0]
+        words = jnp.concatenate([
+            _to_words(jnp, rows),
+            jnp.broadcast_to(jnp.asarray(tail_words_host), (n, 16)),
+        ], axis=1)
+        return _sha256_over_words(
+            jax, jnp, words, row_bytes // 64 + 1, compress)
+
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _build_sha256_fn(head_bytes: int, last_bytes: int):
+    """Jit-compiled ``(u8[N, head_bytes], u8[N, last_bytes]) ->
+    u8[N, 32]``.  ``head`` is the 64-aligned prefix of the rows;
+    ``last`` is the host-assembled remainder + FIPS tail (64 or 128
+    bytes).  One executable per (N, head, last) triple via ordinary jit
+    retrace; the compression graph itself is independent of S."""
+    import jax
+    import jax.numpy as jnp
+
+    compress = _make_compress(jax, jnp, jnp.asarray(_K))
+
     def sha256(head, last):
-        n = head.shape[0]
         # Word-space concat of two 64-aligned buffers, then ONE
         # fori_loop over every block.  Keeping the compress inside the
         # loop (rather than unrolling the tail blocks at top level)
@@ -164,19 +219,8 @@ def _build_sha256_fn(head_bytes: int, last_bytes: int):
         # tests/test_sha256_jax.py for the shape sweep that pins both.
         words = jnp.concatenate(
             [_to_words(jnp, head), _to_words(jnp, last)], axis=1)
-        init = jnp.broadcast_to(h0, (n, 8))
-
-        def block_step(i, state):
-            return compress(state, jax.lax.dynamic_slice(
-                words, (0, i * 16), (n, 16)))
-
-        state = jax.lax.fori_loop(
-            0, (head_bytes + last_bytes) // 64, block_step, init)
-        # big-endian digest bytes [N, 32]
-        out = jnp.stack([
-            (state >> np.uint32(s)).astype(jnp.uint8)
-            for s in (24, 16, 8, 0)], axis=2)
-        return out.reshape(n, 32)
+        return _sha256_over_words(
+            jax, jnp, words, (head_bytes + last_bytes) // 64, compress)
 
     return jax.jit(sha256)
 

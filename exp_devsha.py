@@ -1,0 +1,116 @@
+"""On-chip A/B: device-side SHA-256 vs host SHA for shard hashing.
+
+Decides whether $CHUNKY_BITS_TPU_DEVICE_SHA should default on: the
+device kernel wins if its marginal hashing rate beats the host engine's
+(SHA-NI x cores — ~0.9 GiB/s/core here), because host SHA is the
+measured pipeline ceiling while the chip idles post-encode (VERDICT r4
+item 2; the reference hashes on CPU, src/file/file_part.rs:185).
+
+Three numbers, all by bench.py's marginal method where applicable:
+  1. device SHA alone over [N, 1 MiB] shard rows;
+  2. fused encode+hash dispatch (parity + digests, one transfer) vs the
+     plain parity dispatch — the marginal cost of in-dispatch hashing;
+  3. host engine on the same rows (wall clock, it's synchronous).
+Exits 1 on any digest mismatch vs hashlib.
+"""
+import hashlib
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bench import marginal_seconds
+from chunky_bits_tpu.ops import matrix
+from chunky_bits_tpu.ops.sha256_jax import make_sha256_aligned
+
+d, p = 10, 4
+SMOKE = "--smoke" in sys.argv
+if SMOKE:  # CPU-sized shapes: exercises every code path, numbers
+    batch, size, iters = 2, 1 << 16, 2  # meaningless
+else:
+    batch, size, iters = 64, 1 << 20, 6
+
+rng = np.random.default_rng(0)
+data = rng.integers(0, 256, (batch, d, size), dtype=np.uint8)
+
+# --- correctness gate on-chip ---------------------------------------
+sha_small = jax.jit(make_sha256_aligned(size))
+rows_small = data[:2].reshape(2 * d, size)
+got = np.asarray(sha_small(jnp.asarray(rows_small)))
+want = np.stack([np.frombuffer(hashlib.sha256(r.tobytes()).digest(),
+                               dtype=np.uint8) for r in rows_small])
+if not np.array_equal(got, want):
+    print("device SHA digest mismatch vs hashlib ON CHIP", flush=True)
+    sys.exit(1)
+print("on-chip digest identity: OK", flush=True)
+
+# --- 1. device SHA alone (marginal, [B*d, S] rows as [B', 1, S]) ----
+# marginal_seconds wants [B, K, S]; present rows as [B*d, 1, S]
+rows = data.reshape(batch * d, 1, size)
+x = jnp.asarray(rows)
+xor_cost = marginal_seconds(lambda y: y, x, iters)
+if xor_cost < 0:
+    if not SMOKE:
+        sys.exit("xor baseline did not scale linearly; rerun")
+    xor_cost = 0.0  # smoke: shapes too small to measure, keep going
+sha_fn = make_sha256_aligned(size)
+# marginal_seconds samples the body output as [B, _, :]: present the
+# [N, 32] digests as [N, 1, 32]
+t = marginal_seconds(lambda y: sha_fn(y[:, 0, :])[:, None, :], x, iters)
+dev_gibps = (rows.nbytes / (t - xor_cost) / (1 << 30)
+             if 0 < xor_cost < t else 0.0)
+print(f"device SHA alone: {dev_gibps:.2f} GiB/s "
+      f"({(t - xor_cost) * 1e3:.1f} ms marginal)", flush=True)
+
+# --- 2. fused encode+hash vs plain encode ---------------------------
+from chunky_bits_tpu.ops.jax_backend import JaxBackend
+from chunky_bits_tpu.ops.pallas_kernels import apply_matrix_pallas
+
+be = JaxBackend()
+enc = matrix.build_encode_matrix(d, p)
+parity_rows = enc[d:]
+fused = be._fused_encode_hash_fn(parity_rows, size, interpret=SMOKE)
+x3 = jnp.asarray(data)
+t_plain = marginal_seconds(
+    lambda y: apply_matrix_pallas(parity_rows, y, interpret=SMOKE),
+    x3, iters)
+def _fused_sample(y):
+    # fold the digests into the consumed output: sampling only parity
+    # would let XLA dead-code-eliminate the whole SHA computation and
+    # the "hash overhead" would read ~0
+    par, dig = fused(y)
+    return par.at[:, :, :32].set(par[:, :, :32] ^ dig[:, :par.shape[1]])
+
+
+t_fused = marginal_seconds(_fused_sample, x3, iters)
+xor3 = marginal_seconds(lambda y: y, x3, iters)
+plain = t_plain - xor3
+fusedm = t_fused - xor3
+if xor3 > 0 and plain > 0 and fusedm > 0:
+    print(f"plain encode: {data.nbytes / plain / (1 << 30):.1f} GiB/s | "
+          f"fused encode+hash: {data.nbytes / fusedm / (1 << 30):.1f} "
+          f"GiB/s | hash overhead: {(fusedm - plain) * 1e3:.1f} ms "
+          f"({(fusedm / plain - 1) * 100:.0f}%)", flush=True)
+
+# --- 3. host engine on the same rows --------------------------------
+from chunky_bits_tpu.ops.backend import _row_hasher
+
+hash_rows = _row_hasher()
+flat = data.reshape(batch * d, size)
+out = np.empty((flat.shape[0], 32), dtype=np.uint8)
+hash_rows(flat.reshape(batch, d, size),
+          out.reshape(batch, d, 32))  # warm
+best = float("inf")
+for _ in range(3):
+    t0 = time.perf_counter()
+    hash_rows(flat.reshape(batch, d, size), out.reshape(batch, d, 32))
+    best = min(best, time.perf_counter() - t0)
+host_gibps = flat.nbytes / best / (1 << 30)
+print(f"host SHA engine: {host_gibps:.2f} GiB/s (this host)", flush=True)
+
+print(f"VERDICT: device {'WINS' if dev_gibps > host_gibps else 'loses'}"
+      f" ({dev_gibps:.2f} vs {host_gibps:.2f} GiB/s on this host; "
+      f"multiply host by its core count for other hosts)", flush=True)

@@ -73,3 +73,97 @@ def test_split_tail_alignment():
     # reassembled prefix equals the original row bytes
     joined = np.concatenate([head, last], axis=1)
     assert np.array_equal(joined[:, :100], rows)
+
+
+def test_aligned_builder_matches_hashlib():
+    """make_sha256_aligned (the traceable variant the fused device
+    encode+hash path composes into its dispatch) is byte-identical to
+    hashlib for 64-aligned rows."""
+    import jax
+
+    from chunky_bits_tpu.ops.sha256_jax import make_sha256_aligned
+
+    for s in (64, 128, 1024):
+        rows = np.random.default_rng(s).integers(
+            0, 256, (3, s), dtype=np.uint8)
+        fn = jax.jit(make_sha256_aligned(s))
+        assert np.array_equal(np.asarray(fn(rows)), _hashlib_rows(rows))
+
+
+def test_aligned_builder_rejects_odd_widths():
+    from chunky_bits_tpu.ops.sha256_jax import make_sha256_aligned
+
+    with pytest.raises(ValueError):
+        make_sha256_aligned(100)
+
+
+def test_fused_device_encode_hash_identity(monkeypatch):
+    """The $CHUNKY_BITS_TPU_DEVICE_SHA path: parity AND digests from one
+    fused dispatch (interpret-mode pallas on CPU) must be byte-identical
+    to the numpy oracle's encode_hash_batch."""
+    from chunky_bits_tpu.ops import jax_backend
+    from chunky_bits_tpu.ops.backend import ErasureCoder, NumpyBackend
+
+    d, p, s, b = 3, 2, 1024, 5
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, (b, d, s), dtype=np.uint8)
+    be = jax_backend.JaxBackend()
+    monkeypatch.setenv("CHUNKY_BITS_TPU_DEVICE_SHA", "1")
+    monkeypatch.setattr(be, "_on_tpu", True)
+    # route the fused build through interpret mode (no TPU here), and
+    # force small blocks so the double-buffered block walk is exercised
+    real_build = be._fused_encode_hash_fn
+    monkeypatch.setattr(
+        be, "_fused_encode_hash_fn",
+        lambda mat, size: real_build(mat, size, interpret=True))
+    monkeypatch.setattr(be, "max_pallas_block_bytes", 2 * d * s * 2)
+    from chunky_bits_tpu.ops import matrix
+    enc = matrix.build_encode_matrix(d, p)
+    parity, digests = be.encode_and_hash(enc[d:], data)
+    want_par, want_dig = ErasureCoder(
+        d, p, NumpyBackend()).encode_hash_batch(data)
+    assert np.array_equal(parity, want_par)
+    assert np.array_equal(digests, want_dig)
+
+
+def test_fused_fn_cached_and_failure_sticky(monkeypatch):
+    """The fused executable is cached per (matrix, S) — no per-dispatch
+    retrace — and a failing device-SHA dispatch disables the path for
+    the process (host fallback thereafter, one warning)."""
+    from chunky_bits_tpu.ops import jax_backend, matrix
+    from chunky_bits_tpu.ops.backend import ErasureCoder, NumpyBackend
+
+    d, p, s = 3, 2, 1024
+    be = jax_backend.JaxBackend()
+    enc = matrix.build_encode_matrix(d, p)
+    f1 = be._fused_encode_hash_fn(enc[d:], s, interpret=True)
+    f2 = be._fused_encode_hash_fn(enc[d:], s, interpret=True)
+    assert f1 is f2
+
+    monkeypatch.setenv("CHUNKY_BITS_TPU_DEVICE_SHA", "1")
+    monkeypatch.setattr(be, "_on_tpu", True)
+    calls = []
+
+    def boom(mat, shards):
+        calls.append(1)
+        raise RuntimeError("injected device-SHA failure")
+
+    monkeypatch.setattr(be, "_encode_and_hash_device", boom)
+    data = np.random.default_rng(3).integers(
+        0, 256, (2, d, s), dtype=np.uint8)
+    # pallas parity path is TPU-only; drop to einsum for the fallback
+    # while keeping the device-SHA gate satisfied above
+    monkeypatch.setattr(
+        jax_backend.JaxBackend, "_apply_pallas_blocked",
+        lambda self, mat, shards, on_block=None: (_ for _ in ()).throw(
+            ValueError("no pallas on cpu")))
+    with pytest.warns(UserWarning, match="device SHA path disabled"):
+        parity, digests = be.encode_and_hash(enc[d:], data)
+    want_par, want_dig = ErasureCoder(
+        d, p, NumpyBackend()).encode_hash_batch(data)
+    assert np.array_equal(parity, want_par)
+    assert np.array_equal(digests, want_dig)
+    # second call: sticky flag set, device path never retried
+    parity, digests = be.encode_and_hash(enc[d:], data)
+    assert calls == [1]
+    assert np.array_equal(parity, want_par)
