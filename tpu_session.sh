@@ -1,0 +1,32 @@
+#!/bin/bash
+# The round-5 TPU session: everything that needs the real chip, in
+# priority order (VERDICT r4 items 1, 4, 8 + the device-SHA A/B).
+# Run when the tunnel is up; each step logs to tpu_session/<step>.log
+# and a failed step doesn't stop the rest.  Re-runnable.
+set -u
+mkdir -p tpu_session
+run() {
+  local name=$1; shift
+  echo "=== $name: $* ==="
+  timeout "${STEP_TIMEOUT:-1800}" "$@" 2>&1 | tee "tpu_session/$name.log"
+  echo "=== $name rc=$? ==="
+}
+
+# 1. the round's device record: d10p4 encode/decode + wide d16p8
+run bench python bench.py
+
+# 2. packed-kernel A/B -> decides _PACKED_DEFAULT (flip or delete)
+run exp_packed python exp_packed.py
+
+# 3. device-SHA A/B -> decides CHUNKY_BITS_TPU_DEVICE_SHA default
+run exp_devsha python exp_devsha.py
+
+# 4. wide-stripe tp kernels compiled on one chip (closes the last
+#    interpret-only gap)
+run exp_tp python exp_tp.py
+
+# 5. config 2/3 pipeline numbers on the device backend
+run cfg2 python bench.py --config 2 --gib 0.5
+run cfg3 env CHUNKY_BITS_TPU_BACKEND=jax python bench.py --config 3
+
+echo "=== session done; logs in tpu_session/ ==="
