@@ -82,13 +82,16 @@ def _device_init_watchdog(metric: str):
     import threading
 
     # Bench owns outage handling: the library's bounded degrade-to-CPU
-    # (ops/jax_backend.py) would silently record CPU throughput as the
-    # device metric, so force it off — even an inherited env value
-    # (e.g. the SKILL.md e2e recipe's 15s) must not re-enable it —
-    # and let THIS watchdog's structured record fire instead.
-    from chunky_bits_tpu.ops.jax_backend import DEVICE_INIT_TIMEOUT_ENV
+    # (ops/jax_backend.py; both the init wait and the per-dispatch
+    # guard) would silently record CPU throughput as the device metric,
+    # so force both off — even an inherited env value (e.g. the
+    # SKILL.md e2e recipe's 15s) must not re-enable them — and let THIS
+    # watchdog's structured record fire instead.
+    from chunky_bits_tpu.ops.jax_backend import (DEVICE_INIT_TIMEOUT_ENV,
+                                                 DISPATCH_TIMEOUT_ENV)
 
     os.environ[DEVICE_INIT_TIMEOUT_ENV] = "0"
+    os.environ[DISPATCH_TIMEOUT_ENV] = "0"
 
     fail = ""
     for attempt in range(3):

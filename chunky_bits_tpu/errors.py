@@ -82,6 +82,13 @@ class DeviceInitTimeout(ErasureError):
     ``backend: jax`` in cluster.yaml never hangs a ``cp``."""
 
 
+class DeviceDispatchTimeout(ErasureError):
+    """An in-flight device dispatch exceeded the bounded wait (tunnel
+    died AFTER a successful init).  The jax backends catch it, mark the
+    device dead for the process, and recompute on the native CPU codec
+    — output stays byte-identical, the operation completes."""
+
+
 class ClusterError(ChunkyBitsError):
     """Cluster-level failure (src/error.rs:167-192)."""
 

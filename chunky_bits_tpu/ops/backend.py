@@ -77,6 +77,19 @@ def register_backend(backend: ErasureBackend) -> None:
         _REGISTRY[backend.name] = backend
 
 
+def cpu_fallback_backend() -> ErasureBackend:
+    """The codec used whenever a device backend degrades (init timeout,
+    mid-run dispatch timeout): the native C++ engine when it builds,
+    else numpy.  One definition so every degrade path picks fallbacks
+    identically."""
+    try:
+        from chunky_bits_tpu.ops.cpu_backend import NativeBackend
+
+        return NativeBackend()
+    except Exception:
+        return NumpyBackend()
+
+
 def _build_device_backend(name: str, build, what: str) -> ErasureBackend:
     """Construct a device backend; on a device-init timeout degrade
     ``backend: jax`` to the native CPU codec with a loud warning instead
@@ -98,12 +111,7 @@ def _build_device_backend(name: str, build, what: str) -> ErasureBackend:
             f"native CPU codec for the rest of this process (output "
             f"stays byte-identical, throughput drops to the host's CPU "
             f"band)", RuntimeWarning, stacklevel=4)
-        try:
-            from chunky_bits_tpu.ops.cpu_backend import NativeBackend
-
-            return NativeBackend()
-        except Exception:
-            return NumpyBackend()
+        return cpu_fallback_backend()
     except ErasureError:
         raise
     except Exception as err:  # e.g. no usable jax device/platform

@@ -77,11 +77,10 @@ def await_device_init() -> None:
     is raised — callers fall back to a CPU codec and never touch jax
     again in this process, so the leaked thread is inert.
 
-    Scope: INIT-TIME outages only.  A tunnel that dies after a
-    successful init can still stall an in-flight dispatch — that window
-    is unguarded here (bench.py keeps its own second watchdog for it);
-    bounding every dispatch would tax the hot path for a failure mode
-    the init probe already catches in practice.
+    Scope: init-time outages.  A tunnel that dies after a successful
+    init is caught separately, per dispatch, by
+    :func:`run_bounded_dispatch` (the backends then degrade to the CPU
+    codec mid-run).
 
     Outcomes are sticky for the process lifetime: a success skips all
     later checks, and a timeout fails every later call fast (a stalled
@@ -143,6 +142,76 @@ def await_device_init() -> None:
         _device_ready = True
 
 
+#: bounded wait for an in-flight device dispatch (seconds); 0 disables.
+#: Generous by default: a legitimate multi-GiB dispatch over the ~50
+#: MiB/s dev tunnel takes minutes, and a false positive costs a silent
+#: CPU recompute of the rest of the job.
+DISPATCH_TIMEOUT_ENV = "CHUNKY_BITS_TPU_DISPATCH_TIMEOUT"
+_DISPATCH_TIMEOUT_DEFAULT = 600.0
+
+
+def run_bounded_dispatch(fn, what: str):
+    """Run ``fn`` (a blocking device dispatch + materialization) in a
+    daemon thread with a deadline; raise :class:`DeviceDispatchTimeout`
+    if the device never answers.  Same leaked-parked-thread contract as
+    ``await_device_init``: callers go CPU-only afterwards, so the stuck
+    thread is inert.  With the env knob at 0 the call runs inline
+    (zero overhead, pre-round-5 behavior)."""
+    import os
+
+    from chunky_bits_tpu.errors import DeviceDispatchTimeout, ErasureError
+
+    raw = os.environ.get(DISPATCH_TIMEOUT_ENV, "")
+    try:
+        timeout = float(raw) if raw else _DISPATCH_TIMEOUT_DEFAULT
+    except ValueError:
+        raise ErasureError(
+            f"bad ${DISPATCH_TIMEOUT_ENV}={raw!r} (want seconds)")
+    if timeout <= 0:
+        return fn()
+    done = threading.Event()
+    box: dict[str, object] = {}
+
+    def _run() -> None:
+        try:
+            box["out"] = fn()
+        except BaseException as err:
+            box["err"] = err
+        finally:
+            done.set()
+
+    threading.Thread(target=_run, name="cb-dispatch",
+                     daemon=True).start()
+    if not done.wait(timeout):
+        raise DeviceDispatchTimeout(
+            f"{what} did not answer within {timeout:.0f}s (device "
+            f"tunnel died mid-run?); adjust via ${DISPATCH_TIMEOUT_ENV}")
+    if "err" in box:
+        raise box["err"]
+    return box["out"]
+
+
+class _CallbackGate:
+    """Wrap a block callback so it can be revoked: after ``close()``
+    (taken before a timeout degrade) no further invocation reaches the
+    wrapped callback, including one already racing on the parked
+    dispatch thread — close() serializes behind any in-flight call."""
+
+    def __init__(self, cb):
+        self._cb = cb
+        self._lock = threading.Lock()
+        self._open = True
+
+    def __call__(self, lo, arr) -> None:
+        with self._lock:
+            if self._open:
+                self._cb(lo, arr)
+
+    def close(self) -> None:
+        with self._lock:
+            self._open = False
+
+
 _APPLY_FN = None
 
 
@@ -183,6 +252,10 @@ class JaxBackend(ErasureBackend):
         #: (mirrors the _on_tpu pallas fallback: a failing path must not
         #: re-pay trace/compile/fail on every subsequent dispatch)
         self._device_sha_ok = True
+        #: sticky mid-run device death (dispatch timeout): all further
+        #: work recomputes on the CPU fallback
+        self._device_dead = False
+        self._fallback = None
         self._lock = threading.Lock()
         # 128-aligned shard sizes on a TPU take the fused Pallas kernel
         # (ops/pallas_kernels.py — a TPU-only Mosaic kernel); everything
@@ -205,8 +278,54 @@ class JaxBackend(ErasureBackend):
                 self._m2_cache.popitem(last=False)
         return dev
 
+    def _cpu_fallback(self) -> "ErasureBackend":
+        """The backend used once the device is marked dead mid-run."""
+        if self._fallback is None:
+            from chunky_bits_tpu.ops.backend import cpu_fallback_backend
+
+            self._fallback = cpu_fallback_backend()
+        return self._fallback
+
     def apply_matrix(self, mat: np.ndarray, shards: np.ndarray,
                      on_block=None) -> np.ndarray:
+        """Bounded device dispatch: a tunnel that dies AFTER init would
+        otherwise park this call forever inside PJRT.  On a dispatch
+        timeout the device is dead for the process — every later call
+        recomputes on the native CPU codec, byte-identically."""
+        from chunky_bits_tpu.errors import DeviceDispatchTimeout
+
+        if self._device_dead:
+            out = self._cpu_fallback().apply_matrix(mat, shards)
+            if on_block is not None:
+                on_block(0, out)
+            return out
+        gate = _CallbackGate(on_block) if on_block is not None else None
+        try:
+            return run_bounded_dispatch(
+                lambda: self._apply_matrix_device(mat, shards, gate),
+                "erasure dispatch")
+        except DeviceDispatchTimeout as err:
+            import warnings
+
+            # Close the gate BEFORE degrading: the parked dispatch
+            # thread still holds the callback, and a tunnel answering
+            # late must not write the abandoned attempt's digests into
+            # the caller's state after reconciliation.
+            if gate is not None:
+                gate.close()
+            self._device_dead = True
+            self._on_tpu = False  # forces encode_and_hash's full rehash
+            warnings.warn(
+                f"{err}; DEGRADED to the native CPU codec for the rest "
+                f"of this process (output stays byte-identical)",
+                RuntimeWarning)
+            # on_block deliberately NOT fired here: callers reconcile
+            # never-covered rows themselves (encode_and_hash rehashes
+            # everything once _on_tpu drops)
+            return self._cpu_fallback().apply_matrix(mat, shards)
+
+    def _apply_matrix_device(self, mat: np.ndarray, shards: np.ndarray,
+                             on_block=None) -> np.ndarray:
         jax, jnp = _ensure_jax()
         b, k, s = shards.shape
         r = mat.shape[0]
@@ -245,12 +364,15 @@ class JaxBackend(ErasureBackend):
         and kernel before materializing block N's result lets the next
         host->device transfer (and compute) proceed while the host blocks
         on the previous device->host copy.  Two blocks in flight — the
-        classic double buffer.  ``on_block(lo, arr)`` fires on the main
-        thread as each output block materializes, so callers can overlap
-        host post-processing (shard hashing) with the remaining device
-        work.  ``dispatch`` may return one array or a tuple of arrays
-        (the fused encode+hash path); tuple outputs are concatenated
-        per element, and ``on_block`` must be None for them."""
+        classic double buffer.  ``on_block(lo, arr)`` fires as each
+        output block materializes, so callers can overlap host
+        post-processing (shard hashing) with the remaining device work —
+        NOTE it fires on whatever thread runs the dispatch (the
+        cb-dispatch watchdog thread when the dispatch bound is active,
+        the caller's thread when $CHUNKY_BITS_TPU_DISPATCH_TIMEOUT=0).
+        ``dispatch`` may return one array or a tuple of arrays (the
+        fused encode+hash path); tuple outputs are concatenated per
+        element, and ``on_block`` must be None for them."""
         jax, _ = _ensure_jax()
 
         def materialize(o):
@@ -394,11 +516,28 @@ class JaxBackend(ErasureBackend):
             return parity, np.concatenate(
                 [data_digests, parity_digests], axis=1)
         if (self._device_sha_ok and self._device_sha_enabled()
-                and self._on_tpu and s % 128 == 0 and s >= 1024):
+                and self._on_tpu and not self._device_dead
+                and s % 128 == 0 and s >= 1024):
             # same eligibility gate as the pallas parity path, so the
             # fused dispatch never mixes kernels mid-batch
+            from chunky_bits_tpu.errors import DeviceDispatchTimeout
+
             try:
-                return self._encode_and_hash_device(mat, shards)
+                return run_bounded_dispatch(
+                    lambda: self._encode_and_hash_device(mat, shards),
+                    "fused encode+hash dispatch")
+            except DeviceDispatchTimeout as err:
+                import warnings
+
+                # the device is gone, not just this path: skip straight
+                # to CPU instead of re-paying the timeout on the plain
+                # parity dispatch below
+                self._device_sha_ok = False
+                self._device_dead = True
+                self._on_tpu = False
+                warnings.warn(
+                    f"{err}; DEGRADED to the native CPU codec for the "
+                    f"rest of this process", RuntimeWarning)
             except Exception as err:
                 import warnings
 

@@ -91,6 +91,8 @@ class MeshJaxBackend(ErasureBackend):
         # ("dp=4, sp=2", "sp2" on 8 devices, ...) dedupe to one registry
         # entry and one set of jitted executables.
         self.name = f"jax:dp{self.dp},{minor_name}{self.minor}"
+        self._device_dead = False
+        self._fallback = None
 
     def apply_matrix(self, mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
         b, k, s = shards.shape
@@ -100,11 +102,37 @@ class MeshJaxBackend(ErasureBackend):
         if self._wide and k % self.minor != 0:
             raise ErasureError(
                 f"stripe width {k} not divisible by tp={self.minor}")
+        if self._device_dead:
+            return self._cpu_fallback().apply_matrix(mat, shards)
         pad_b = (-b) % self.dp
         pad_s = 0 if self._wide else (-s) % self.minor
         if pad_b or pad_s:
             shards = np.pad(shards, ((0, pad_b), (0, 0), (0, pad_s)))
-        out = np.asarray(self._apply(self.mesh, mat, shards))
+        from chunky_bits_tpu.errors import DeviceDispatchTimeout
+        from chunky_bits_tpu.ops.jax_backend import run_bounded_dispatch
+
+        try:
+            out = run_bounded_dispatch(
+                lambda: np.asarray(self._apply(self.mesh, mat, shards)),
+                "mesh erasure dispatch")
+        except DeviceDispatchTimeout as err:
+            import warnings
+
+            self._device_dead = True
+            warnings.warn(
+                f"{err}; DEGRADED to the native CPU codec for the rest "
+                f"of this process (output stays byte-identical)",
+                RuntimeWarning)
+            return self._cpu_fallback().apply_matrix(
+                mat, shards[:b, :, :s] if (pad_b or pad_s) else shards)
         if pad_b or pad_s:
             out = out[:b, :, :s]
         return np.ascontiguousarray(out)
+
+    def _cpu_fallback(self):
+        """The backend used once the mesh is marked dead mid-run."""
+        if self._fallback is None:
+            from chunky_bits_tpu.ops.backend import cpu_fallback_backend
+
+            self._fallback = cpu_fallback_backend()
+        return self._fallback

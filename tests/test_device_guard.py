@@ -104,3 +104,104 @@ def test_timeout_is_sticky_and_fails_fast(dead_tunnel, monkeypatch):
         jax_backend.await_device_init()
     assert second.value is first.value
     assert calls == []
+
+
+class _BlockingApply:
+    """Stands in for a device dispatch parked inside PJRT."""
+
+    def __init__(self):
+        self.release = threading.Event()
+
+    def __call__(self, *a, **kw):
+        self.release.wait()
+
+
+def test_dispatch_timeout_degrades_jax_backend(monkeypatch):
+    """A tunnel death AFTER init: the in-flight dispatch times out, the
+    backend goes CPU-only for the process, output stays byte-identical,
+    and later calls never touch the device again."""
+    from chunky_bits_tpu.ops import jax_backend, matrix
+
+    be = jax_backend.JaxBackend()
+    blocker = _BlockingApply()
+    monkeypatch.setattr(be, "_apply_matrix_device", blocker)
+    monkeypatch.setenv(jax_backend.DISPATCH_TIMEOUT_ENV, "0.05")
+    d, p = 3, 2
+    enc = matrix.build_encode_matrix(d, p)
+    data = np.random.default_rng(9).integers(
+        0, 256, (2, d, 2048), dtype=np.uint8)
+    want = ErasureCoder(d, p, NumpyBackend()).encode_batch(data)
+    try:
+        with pytest.warns(RuntimeWarning, match="DEGRADED"):
+            got = be.apply_matrix(enc[d:], data)
+        assert np.array_equal(got, want)
+        assert be._device_dead
+        # second call: straight to CPU, no bounded wait, no new warning
+        calls_before = blocker.release.is_set()
+        t0 = __import__("time").perf_counter()
+        got2 = be.apply_matrix(enc[d:], data)
+        assert __import__("time").perf_counter() - t0 < 1.0
+        assert np.array_equal(got2, want)
+        assert calls_before is False
+    finally:
+        blocker.release.set()
+
+
+def test_dispatch_timeout_degrades_mesh_backend(monkeypatch):
+    from chunky_bits_tpu.ops import matrix
+    from chunky_bits_tpu.ops import jax_backend
+    from chunky_bits_tpu.parallel.backend import MeshJaxBackend
+
+    be = MeshJaxBackend("dp2,sp2")
+    blocker = _BlockingApply()
+    monkeypatch.setattr(be, "_apply", blocker)
+    monkeypatch.setenv(jax_backend.DISPATCH_TIMEOUT_ENV, "0.05")
+    d, p = 3, 2
+    enc = matrix.build_encode_matrix(d, p)
+    data = np.random.default_rng(10).integers(
+        0, 256, (2, d, 2048), dtype=np.uint8)
+    want = ErasureCoder(d, p, NumpyBackend()).encode_batch(data)
+    try:
+        with pytest.warns(RuntimeWarning, match="DEGRADED"):
+            got = be.apply_matrix(enc[d:], data)
+        assert np.array_equal(got, want)
+        got2 = be.apply_matrix(enc[d:], data)  # sticky, no device touch
+        assert np.array_equal(got2, want)
+    finally:
+        blocker.release.set()
+
+
+def test_dispatch_bound_disabled_runs_inline(monkeypatch):
+    """With the knob at 0 the dispatch runs inline on the caller's
+    thread (no watchdog thread, no overhead) — the bench sets this."""
+    from chunky_bits_tpu.ops import jax_backend
+
+    monkeypatch.setenv(jax_backend.DISPATCH_TIMEOUT_ENV, "0")
+    tid = []
+    out = jax_backend.run_bounded_dispatch(
+        lambda: tid.append(threading.get_ident()) or 42, "test")
+    assert out == 42
+    assert tid == [threading.get_ident()]
+
+
+def test_dispatch_bad_env_value_loud(monkeypatch):
+    from chunky_bits_tpu.errors import DeviceDispatchTimeout, ErasureError
+    from chunky_bits_tpu.ops import jax_backend
+
+    monkeypatch.setenv(jax_backend.DISPATCH_TIMEOUT_ENV, "10m")
+    with pytest.raises(ErasureError, match="10m") as exc:
+        jax_backend.run_bounded_dispatch(lambda: 1, "test")
+    assert not isinstance(exc.value, DeviceDispatchTimeout)
+
+
+def test_callback_gate_blocks_late_firing():
+    """A dispatch thread answering AFTER the timeout degrade must not
+    reach the caller's callback (digest-corruption guard)."""
+    from chunky_bits_tpu.ops.jax_backend import _CallbackGate
+
+    seen = []
+    gate = _CallbackGate(lambda lo, arr: seen.append(lo))
+    gate(0, None)
+    gate.close()
+    gate(1, None)  # the late, abandoned-attempt delivery
+    assert seen == [0]
