@@ -13,11 +13,13 @@ fills the VPU's lanes while a ``fori_loop`` walks the 64-byte blocks.
 Everything is 32-bit integer adds/rotates/xors — native VPU ops; no MXU
 involvement, so on a mesh it can run concurrently with GF matmuls.
 
-Layout: rows ``u8[N, S]`` are repacked once to big-endian ``u32[N, W]``
-words (vectorized shifts), then the compression loop keeps the running
-digest as ``u32[N, 8]``.  The schedule expansion, the 64 rounds, and
-the block walk are all ``fori_loop``s — small loop bodies keep the
-graph (and compile time) flat in S, and dodge a superlinear
+Layout: rows ``u8[N, S]`` are repacked once to big-endian words and
+transposed to words-major ``u32[W, N]`` (one fused pass), so the block
+walk reads contiguous 16-row slices; the running digest is a tuple of
+eight flat ``u32[N]`` vectors, so every arithmetic op fills the VPU
+lanes with zero per-round repacking.  The schedule expansion, the 64
+rounds, and the block walk are all ``fori_loop``s — small loop bodies
+keep the graph (and compile time) flat in S, and dodge a superlinear
 compile/execute blowup this jax build's CPU backend hits on big
 unrolled integer bodies (see ``compress``).
 
@@ -100,68 +102,77 @@ def _to_words(jnp, buf):
 
 def _make_compress(jax, jnp, k):
     def compress(state, w16):
-        """One FIPS 180-4 block over u32[N, 16], rows vectorized.
+        """One FIPS 180-4 block; ``state`` is a tuple of eight
+        ``u32[N]`` vectors, ``w16`` is ``u32[16, N]`` (words-major).
+
+        Layout rationale: every arithmetic op runs on a full flat
+        ``[N]`` vector, which XLA tiles across all VPU lanes; the
+        words-major schedule makes each ``w[t]`` access a contiguous
+        row slice instead of a strided per-lane column gather.
 
         Both phases are ``fori_loop``s, NOT unrolled: the unrolled
         64-round body (~2000 straight-line int ops) sends this jax
         build's CPU backend into a superlinear compile/execute blowup
         (8 rounds 0.5 s, 32 rounds 3.4 s, 64 rounds never returns).
-        Loop bodies of ~25 ops keep compile trivial everywhere; the
-        batch axis still fills the VPU lanes."""
-        n = w16.shape[0]
+        Loop bodies of ~25 ops keep compile trivial everywhere."""
+        n = w16.shape[1]
+
+        def row(w, t):
+            return jax.lax.dynamic_slice(w, (t, 0), (1, n))[0]
 
         def sched_step(t, w):
-            w15 = jax.lax.dynamic_slice(w, (0, t - 15), (n, 1))[:, 0]
-            w2 = jax.lax.dynamic_slice(w, (0, t - 2), (n, 1))[:, 0]
-            w16_ = jax.lax.dynamic_slice(w, (0, t - 16), (n, 1))[:, 0]
-            w7 = jax.lax.dynamic_slice(w, (0, t - 7), (n, 1))[:, 0]
+            w15, w2 = row(w, t - 15), row(w, t - 2)
+            w16_, w7 = row(w, t - 16), row(w, t - 7)
             s0 = (_rotr(w15, 7) ^ _rotr(w15, 18)
                   ^ (w15 >> np.uint32(3)))
             s1 = (_rotr(w2, 17) ^ _rotr(w2, 19)
                   ^ (w2 >> np.uint32(10)))
             return jax.lax.dynamic_update_slice(
-                w, (w16_ + s0 + w7 + s1)[:, None], (0, t))
+                w, (w16_ + s0 + w7 + s1)[None, :], (t, 0))
 
         w = jnp.concatenate(
-            [w16, jnp.zeros((n, 48), jnp.uint32)], axis=1)
+            [w16, jnp.zeros((48, n), jnp.uint32)], axis=0)
         w = jax.lax.fori_loop(16, 64, sched_step, w)
 
         def round_step(t, vs):
-            a, b, c, d, e, f, g, h = [vs[:, j] for j in range(8)]
-            wt = jax.lax.dynamic_slice(w, (0, t), (n, 1))[:, 0]
-            s1 = (_rotr(e, 6) ^ _rotr(e, 11)
-                  ^ _rotr(e, 25))
+            a, b, c, d, e, f, g, h = vs
+            wt = row(w, t)
+            s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
             ch = (e & f) ^ (~e & g)
             t1 = h + s1 + ch + k[t] + wt
-            s0 = (_rotr(a, 2) ^ _rotr(a, 13)
-                  ^ _rotr(a, 22))
+            s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
             maj = (a & b) ^ (a & c) ^ (b & c)
-            return jnp.stack(
-                [t1 + s0 + maj, a, b, c, d + t1, e, f, g], axis=1)
+            return (t1 + s0 + maj, a, b, c, d + t1, e, f, g)
 
         vs = jax.lax.fori_loop(0, 64, round_step, state)
-        return state + vs
+        return tuple(s + v for s, v in zip(state, vs))
 
     return compress
 
 
 def _digest_bytes(jnp, state):
-    """``u32[N, 8] -> u8[N, 32]`` big-endian digest bytes."""
+    """state tuple of eight ``u32[N]`` -> ``u8[N, 32]`` big-endian."""
+    stacked = jnp.stack(state, axis=1)  # [N, 8]
     out = jnp.stack([
-        (state >> np.uint32(s)).astype(jnp.uint8)
+        (stacked >> np.uint32(s)).astype(jnp.uint8)
         for s in (24, 16, 8, 0)], axis=2)
-    return out.reshape(state.shape[0], 32)
+    return out.reshape(stacked.shape[0], 32)
 
 
 def _sha256_over_words(jax, jnp, words, nblocks: int, compress):
     """Run ``compress`` over ``nblocks`` 16-word blocks of
     ``u32[N, 16*nblocks]``; returns digest bytes ``u8[N, 32]``."""
     n = words.shape[0]
-    init = jnp.broadcast_to(jnp.asarray(_H0), (n, 8))
+    # One whole-buffer transpose up front (XLA fuses it with the
+    # byte->word conversion feeding this), so the hot loop's block
+    # reads are contiguous row ranges instead of 16384 tiny strided
+    # per-block transposes.
+    words_major = words.T  # [16*nblocks, N]
+    init = tuple(jnp.broadcast_to(jnp.uint32(h), (n,)) for h in _H0)
 
     def block_step(i, state):
         return compress(state, jax.lax.dynamic_slice(
-            words, (0, i * 16), (n, 16)))
+            words_major, (i * 16, 0), (16, n)))
 
     state = jax.lax.fori_loop(0, nblocks, block_step, init)
     return _digest_bytes(jnp, state)
