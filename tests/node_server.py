@@ -1,0 +1,25 @@
+"""Standalone HTTP storage-node process for the multi-process e2e.
+
+Runs tests/http_node.py's FakeHttpNode in its own interpreter: child
+processes give the distributed tests real failure domains — a SIGKILL
+here is an actual node crash with TCP resets, not an in-process
+cancellation.  Prints "PORT <n>" on stdout once listening, then serves
+until killed.
+"""
+
+import asyncio
+import sys
+
+
+async def main() -> None:
+    sys.path.insert(0, sys.argv[1])  # repo root (child has no conftest)
+    from tests.http_node import FakeHttpNode
+
+    node = FakeHttpNode()
+    await node.start()
+    print(f"PORT {node.port}", flush=True)
+    await asyncio.Event().wait()  # serve until killed
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
