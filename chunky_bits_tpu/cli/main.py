@@ -23,7 +23,7 @@ import sys
 
 from chunky_bits_tpu.cli.cluster_location import ClusterLocation
 from chunky_bits_tpu.cli.config import Config
-from chunky_bits_tpu.errors import ChunkyBitsError
+from chunky_bits_tpu.errors import ChunkyBitsError, LocationError
 from chunky_bits_tpu.file import AnyHash, Location
 from chunky_bits_tpu.ops import get_coder
 from chunky_bits_tpu.utils import aio
@@ -342,10 +342,36 @@ async def find_unused_hashes(config, args) -> None:
             return "gone"
         return "old" if st.st_mtime < cutoff else "fresh"
 
+    # Atomic local publication stages temp files and renames in
+    # (location.is_publish_temp defines the format next to its
+    # producer); a writer killed hard leaves the temp behind with no
+    # other reclamation path.  A temp is invisible until renamed, so
+    # any one older than the grace window is dead — remove it here
+    # (the scan ignores other unknown names, as the reference does,
+    # main.rs:372-377).
+    from chunky_bits_tpu.file.location import is_publish_temp
+
+    async def _reap_stale_temp(path: str) -> bool:
+        if not is_publish_temp(os.path.basename(path)):
+            return False
+        if args.grace_seconds > 0 and await _age_of(path) != "old":
+            return True  # a live writer's temp: skip, don't report
+        print(f"Stale publish temp: {path}", file=sys.stderr)
+        if args.remove:
+            try:
+                await Location.local(path).delete()
+            except (LocationError, FileNotFoundError):
+                pass  # renamed/reaped concurrently: goal achieved
+        return True
+
     async def hash_files():
         for hash_dir in hash_dirs:
             async for entry in hash_dir.list_files_recursive(config):
-                if entry.is_file() and await _age_of(entry.path) == "old":
+                if not entry.is_file():
+                    continue
+                if await _reap_stale_temp(entry.path):
+                    continue
+                if await _age_of(entry.path) == "old":
                     yield entry.path
 
     files_iter = hash_files()

@@ -19,6 +19,7 @@ import asyncio
 import errno
 import io
 import os
+import re
 import time
 from dataclasses import dataclass, field, replace
 from typing import Optional
@@ -136,6 +137,24 @@ def default_context() -> LocationContext:
     return _DEFAULT_CONTEXT
 
 
+#: Atomic local publication stages "<target>.tmp.<pid>.<8-hex>" and
+#: os.replace()s it in; GC's stale-temp reaping (cli/main.py) and its
+#: tests key off these same definitions so the format can't drift.
+_PUBLISH_TEMP_RE = re.compile(r"\.tmp\.\d+\.[0-9a-f]{8}$")
+
+
+def publish_temp_name(target: str) -> str:
+    """The staging path for an atomic publication of ``target``."""
+    return f"{target}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
+
+
+def is_publish_temp(name: str) -> bool:
+    """True when ``name`` (a basename or path) is an atomic-publication
+    temp file — invisible to readers until renamed, so one older than
+    any reasonable write duration is a crashed writer's leak."""
+    return _PUBLISH_TEMP_RE.search(name) is not None
+
+
 async def _publish_atomically(target: str, write_body) -> int:
     """Local write published atomically where possible; the single
     implementation of the publish protocol for both the whole-buffer and
@@ -167,7 +186,7 @@ async def _publish_atomically(target: str, write_body) -> int:
         mode = os.stat(target).st_mode & 0o7777
     except OSError:
         pass
-    tmp = f"{target}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
+    tmp = publish_temp_name(target)
     try:
         total = await write_body(tmp)
         if mode is not None:

@@ -171,3 +171,40 @@ def test_delete_time_recheck_spares_rewritten_chunk(cluster):
 
     asyncio.run(run())
     assert os.path.exists(path)
+
+
+def test_stale_publish_temps_reaped_live_ones_spared(cluster):
+    """A crashed writer's '<name>.tmp.<pid>.<hex>' file is reclaimed
+    once aged past the grace window; a live writer's fresh temp — and
+    non-matching unknown names — are left alone."""
+    yaml_path, disks = cluster
+    stale = os.path.join(disks[0], "sha256-" + "a" * 64 + ".tmp.1234.deadbeef")
+    live = os.path.join(disks[1], "sha256-" + "b" * 64 + ".tmp.5678.cafebabe")
+    unknown = os.path.join(disks[2], "notes.txt")
+    for p in (stale, live, unknown):
+        with open(p, "wb") as f:
+            f.write(b"x")
+    old = time.time() - 3600
+    os.utime(stale, (old, old))
+    os.utime(unknown, (old, old))
+
+    async def run() -> None:
+        config = await Config.load_or_default(None)
+        await find_unused_hashes(config, _gc_args(yaml_path, disks))
+
+    asyncio.run(run())
+    assert not os.path.exists(stale)
+    assert os.path.exists(live)
+    assert os.path.exists(unknown)
+
+
+def test_temp_predicate_matches_producer():
+    """The GC's temp predicate and the publisher's naming can't drift:
+    a name generated by the producer must match the predicate."""
+    from chunky_bits_tpu.file.location import (is_publish_temp,
+                                               publish_temp_name)
+
+    name = publish_temp_name("/x/sha256-" + "a" * 64)
+    assert is_publish_temp(os.path.basename(name))
+    assert not is_publish_temp("sha256-" + "a" * 64)
+    assert not is_publish_temp("notes.txt")
