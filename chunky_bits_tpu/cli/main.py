@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import errno
 import json
 import os
 import sys
@@ -360,8 +361,14 @@ async def find_unused_hashes(config, args) -> None:
         if args.remove:
             try:
                 await Location.local(path).delete()
-            except (LocationError, FileNotFoundError):
-                pass  # renamed/reaped concurrently: goal achieved
+            except LocationError as err:
+                # only the missing-file race is benign (renamed or
+                # reaped concurrently); EACCES/EROFS etc. must surface
+                # like the ordinary chunk path's failures do
+                cause = err.__cause__
+                if not (isinstance(cause, OSError)
+                        and cause.errno == errno.ENOENT):
+                    raise
         return True
 
     async def hash_files():
