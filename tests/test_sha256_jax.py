@@ -157,8 +157,14 @@ def test_fused_fn_cached_and_failure_sticky(monkeypatch):
         jax_backend.JaxBackend, "_apply_pallas_blocked",
         lambda self, mat, shards, on_block=None: (_ for _ in ()).throw(
             ValueError("no pallas on cpu")))
-    with pytest.warns(UserWarning, match="device SHA path disabled"):
+    with pytest.warns(UserWarning) as caught:
         parity, digests = be.encode_and_hash(enc[d:], data)
+    # two expected warnings: the injected device-SHA failure disables
+    # that path, then the pallas-blocked monkeypatch disables the
+    # pallas parity path (fallback to einsum)
+    texts = [str(w.message) for w in caught]
+    assert any("device SHA path disabled" in t for t in texts), texts
+    assert any("pallas erasure kernel disabled" in t for t in texts), texts
     want_par, want_dig = ErasureCoder(
         d, p, NumpyBackend()).encode_hash_batch(data)
     assert np.array_equal(parity, want_par)
