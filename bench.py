@@ -93,9 +93,14 @@ def _device_init_watchdog(metric: str):
     os.environ[DEVICE_INIT_TIMEOUT_ENV] = "0"
     os.environ[DISPATCH_TIMEOUT_ENV] = "0"
 
+    # Probe budget: 3 x 120s + 15s + 30s backoff = 405s worst case —
+    # deliberately under the old watchdog's 600s so the structured
+    # outage record always lands inside any driver-side cap sized for
+    # the previous behavior.  120s comfortably covers a healthy cold
+    # init (~20-40s).
     fail = ""
     for attempt in range(3):
-        fail = _probe_device(180)
+        fail = _probe_device(120)
         if not fail:
             break
         if fail != "timeout":
@@ -106,7 +111,7 @@ def _device_init_watchdog(metric: str):
                 "vs_baseline": 0.0, "error": fail}), flush=True)
             sys.exit(3)
         if attempt < 2:
-            delay = 20 * (attempt + 1)
+            delay = 15 * (attempt + 1)
             print(f"# device probe {attempt + 1}/3 timed out; retrying "
                   f"in {delay}s", file=sys.stderr, flush=True)
             time.sleep(delay)
