@@ -41,6 +41,22 @@ def _outage_record(metric: str) -> str:
     })
 
 
+def _env_shrink(name: str, default: float) -> float:
+    """Test-seam env override that can only SHRINK ``default``:
+    malformed, non-positive, or larger values fall back, so inherited
+    variables can't break the bench's timing/output contract."""
+    import os
+
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if 0 < v < default else default
+
+
 def _probe_device(timeout_s: float) -> str:
     """PJRT init probe in a throwaway subprocess: when the tunnel is
     down, jax.devices() blocks forever and cannot be interrupted
@@ -51,12 +67,25 @@ def _probe_device(timeout_s: float) -> str:
 
     Returns "" on success, "timeout" on a hang, else the child's
     stderr tail — a crash (broken install, PJRT abort) must surface as
-    itself, not be recorded as a tunnel outage."""
+    itself, not be recorded as a tunnel outage.
+
+    Test seams (tests/test_bench_outage.py): the child's program and
+    the per-probe timeout are env-overridable so the hang/crash paths
+    can be exercised in milliseconds without a real tunnel.  The seams
+    can only SHRINK budgets (and a malformed value is ignored), so an
+    inherited variable can neither crash the one-JSON-line contract
+    nor push the worst case past the 405s the driver cap is sized
+    for."""
+    import os
     import subprocess
 
+    prog = os.environ.get("CHUNKY_BITS_TPU_BENCH_PROBE_PY",
+                          "import jax; jax.devices()")
+    timeout_s = _env_shrink("CHUNKY_BITS_TPU_BENCH_PROBE_SECS",
+                            timeout_s)
     try:
         r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [sys.executable, "-c", prog],
             timeout=timeout_s, capture_output=True)
     except subprocess.TimeoutExpired:
         return "timeout"
@@ -111,9 +140,10 @@ def _device_init_watchdog(metric: str):
                 "vs_baseline": 0.0, "error": fail}), flush=True)
             sys.exit(3)
         if attempt < 2:
-            delay = 15 * (attempt + 1)
+            delay = 15 * (attempt + 1) * _env_shrink(
+                "CHUNKY_BITS_TPU_BENCH_BACKOFF_SCALE", 1.0)
             print(f"# device probe {attempt + 1}/3 timed out; retrying "
-                  f"in {delay}s", file=sys.stderr, flush=True)
+                  f"in {delay:g}s", file=sys.stderr, flush=True)
             time.sleep(delay)
     else:
         print(_outage_record(metric), flush=True)

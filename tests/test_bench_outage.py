@@ -1,0 +1,68 @@
+"""The bench outage contract (driver-facing, BENCH_r{N}.json).
+
+When the device tunnel is down, ``python bench.py`` must emit ONE
+parseable JSON line with ``tunnel_down: true`` and the last-good
+numbers, exit 3, and do it fast enough to beat a driver-side cap; a
+crashing probe child (broken env) must surface as itself, not as an
+outage.  Exercised via the probe seams so no real tunnel (or hang) is
+involved — the real-outage run was also verified live (BASELINE.md
+round-5 state).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(probe_py: str, timeout=120):
+    env = dict(os.environ,
+               PYTHONPATH=REPO,
+               CHUNKY_BITS_TPU_BENCH_PROBE_PY=probe_py,
+               CHUNKY_BITS_TPU_BENCH_PROBE_SECS="0.3",
+               CHUNKY_BITS_TPU_BENCH_BACKOFF_SCALE="0.01")
+    return subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, timeout=timeout)
+
+
+def test_tunnel_down_emits_structured_record_fast():
+    t0 = time.monotonic()
+    r = _run_bench("import time; time.sleep(30)")
+    assert r.returncode == 3, r.stderr.decode()[-500:]
+    assert time.monotonic() - t0 < 60
+    rec = json.loads(r.stdout.decode().strip().splitlines()[-1])
+    assert rec["tunnel_down"] is True
+    assert rec["value"] == 0.0
+    assert rec["last_good"]["encode_gibps"] > 0
+    # driver-parsed fields must all be present
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec
+
+
+def test_probe_crash_is_not_an_outage():
+    r = _run_bench("import sys; print('boom', file=sys.stderr); "
+                   "sys.exit(7)")
+    assert r.returncode == 3
+    rec = json.loads(r.stdout.decode().strip().splitlines()[-1])
+    assert "tunnel_down" not in rec
+    assert "probe rc=7" in rec["error"]
+    assert "boom" in rec["error"]
+
+
+def test_seams_only_shrink_and_tolerate_garbage():
+    """Inherited env values must not break the contract: malformed or
+    larger-than-default values fall back to the real budget."""
+    import bench
+
+    for raw, want in (("", 120.0), ("15s", 120.0), ("-3", 120.0),
+                      ("900", 120.0), ("0.5", 0.5)):
+        os.environ["CHUNKY_BITS_TPU_BENCH_PROBE_SECS"] = raw
+        try:
+            assert bench._env_shrink(
+                "CHUNKY_BITS_TPU_BENCH_PROBE_SECS", 120.0) == want, raw
+        finally:
+            del os.environ["CHUNKY_BITS_TPU_BENCH_PROBE_SECS"]
