@@ -532,6 +532,18 @@ class Location:
         inode stays mapped.  A file truncated by an *external* writer
         can still SIGBUS a held view; set ``CHUNKY_BITS_TPU_NO_MMAP=1``
         for clusters whose storage is shared with such writers."""
+        mapper = self.read_view_mapper(cx)
+        if mapper is None:
+            return None
+        return await asyncio.to_thread(mapper)
+
+    def read_view_mapper(self, cx: Optional[LocationContext] = None):
+        """The synchronous mapper behind :meth:`read_view` (or ``None``
+        when the zero-copy path doesn't apply).  Callers already inside
+        a worker thread can run it there and fuse their own sync work
+        (e.g. hash verification) into the same thread hop — per-chunk
+        hop latency, not bytes, dominates warm local reads on small
+        hosts."""
         cx = cx or default_context()
         if (not self.is_local() or cx.profiler is not None
                 or aio.mmap_opted_out()):
@@ -558,7 +570,7 @@ class Location:
                 return None
             return memoryview(mm)[start:end]
 
-        return await asyncio.to_thread(_map)
+        return _map
 
     # ---- write path ----
 
