@@ -500,13 +500,19 @@ class Location:
             # _open_reader, not reader(): this whole-buffer op logs its own
             # single profiler entry below.
             reader = await self._open_reader(cx)
-            chunks = []
-            while True:
-                data = await reader.read(1 << 20)
-                if not data:
-                    break
-                chunks.append(data)
-            out = b"".join(chunks)
+            try:
+                chunks = []
+                while True:
+                    data = await reader.read(1 << 20)
+                    if not data:
+                        break
+                    chunks.append(data)
+                out = b"".join(chunks)
+            finally:
+                # EOF does not release the underlying file/response;
+                # an unclosed handle per whole-buffer read leaks fds
+                # (surfaces as ResourceWarning under -W error)
+                await aio.close_reader(reader)
         except LocationError as err:
             if cx.profiler is not None:
                 cx.profiler.log_read(False, str(err), self, 0, start)
