@@ -110,24 +110,73 @@ class LocationContext:
         return cx
 
     def http_session(self):
-        """The aiohttp session for the running loop (loop-bound, cached)."""
+        """The aiohttp session for the running loop (loop-bound, cached).
+
+        Entries are validated against a weakref of their loop: ``id()``
+        of a dead loop can be recycled by a new one, and handing out a
+        session bound to a dead loop would fail strangely.  Each new
+        session also arms a primed async generator whose finalizer
+        closes it — ``asyncio.run``'s ``shutdown_asyncgens`` then tears
+        the session down while its loop is still alive, so short-lived
+        loops (tests, scripts) don't leak connectors even when nobody
+        calls :meth:`aclose`."""
+        import weakref
+
         import aiohttp
 
         loop = asyncio.get_running_loop()
-        sess = self._sessions.get(id(loop))
-        if sess is None or sess.closed:
-            headers = {}
-            if self.user_agent:
-                headers["User-Agent"] = self.user_agent
-            sess = aiohttp.ClientSession(headers=headers)
-            self._sessions[id(loop)] = sess
+        entry = self._sessions.get(id(loop))
+        if entry is not None:
+            loop_ref, sess = entry[0], entry[1]
+            if loop_ref() is loop and not sess.closed:
+                return sess
+            del self._sessions[id(loop)]  # stale: dead/recycled loop
+        headers = {}
+        if self.user_agent:
+            headers["User-Agent"] = self.user_agent
+        sess = aiohttp.ClientSession(headers=headers)
+
+        async def _closer():
+            try:
+                yield
+            finally:
+                if not sess.closed:
+                    await sess.close()
+
+        gen = _closer()
+        # Prime it so the loop tracks the generator and finalizes it at
+        # shutdown_asyncgens.  The cache entry holds the strong ref:
+        # the loop's own asyncgen registry is a WeakSet, and an
+        # unreferenced suspended generator would be GC-finalized — and
+        # close the session — while the loop is still serving.
+        primer = asyncio.ensure_future(gen.__anext__())
+        # entries for dead loops can't be awaited-closed anymore; sweep
+        # them here so a long-lived process running many short loops
+        # doesn't pin one (ref, session, gen) tuple per dead loop
+        for key, (ref, _s, _g, _p) in list(self._sessions.items()):
+            if ref() is None:
+                del self._sessions[key]
+        self._sessions[id(loop)] = (weakref.ref(loop), sess, gen, primer)
         return sess
 
     async def aclose(self) -> None:
         loop = asyncio.get_running_loop()
-        sess = self._sessions.pop(id(loop), None)
-        if sess is not None and not sess.closed:
-            await sess.close()
+        entry = self._sessions.pop(id(loop), None)
+        if entry is not None:
+            _ref, sess, gen, primer = entry
+            if not primer.done():
+                primer.cancel()
+            # retrieve the primer's outcome either way: closing the
+            # generator before the primer ran leaves it dying with
+            # StopAsyncIteration, which must not surface as a
+            # never-retrieved task exception
+            try:
+                await primer
+            except (asyncio.CancelledError, StopAsyncIteration):
+                pass
+            await gen.aclose()  # runs the closer's finally
+            if not sess.closed:
+                await sess.close()
 
 
 _DEFAULT_CONTEXT = LocationContext()

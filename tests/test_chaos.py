@@ -13,6 +13,7 @@ step:
 
 import asyncio
 import os
+import pathlib
 
 import numpy as np
 import pytest
@@ -82,9 +83,9 @@ def test_chaos_soak(tmp_path, seed):
         if not os.path.exists(path):
             return  # shared content-addressed chunk already damaged
         if corrupt:
-            raw = bytearray(open(path, "rb").read())
+            raw = bytearray(pathlib.Path(path).read_bytes())
             raw[int(rng.integers(0, len(raw)))] ^= 0x01
-            open(path, "wb").write(bytes(raw))
+            pathlib.Path(path).write_bytes(bytes(raw))
         else:
             os.remove(path)
         damaged[name].add((part_idx, ci))

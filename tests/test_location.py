@@ -163,6 +163,7 @@ def test_fs_streaming(tmp_path):
         dloc = Location.parse(str(dst))
         reader = await sloc.reader()
         n = await dloc.write_from_reader(reader)
+        await aio.close_reader(reader)
         assert n == 3 << 20
         assert dst.read_bytes() == src.read_bytes()
 
@@ -266,6 +267,7 @@ def test_streaming_profiler_hooks(tmp_path):
             if not data:
                 break
             total += len(data)
+        await aio_utils.close_reader(reader)
         assert total == len(payload)
 
         # early close: entry logged with partial count, not dropped

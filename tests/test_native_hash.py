@@ -7,6 +7,7 @@ pin it byte-for-byte to hashlib and to the unfused path.
 """
 
 import hashlib
+import pathlib
 
 import numpy as np
 import pytest
@@ -291,9 +292,9 @@ def test_verify_fused_file_hash(tmp_path, monkeypatch):
         assert calls, "fused hasher never engaged"
         # corrupt one chunk in place: flip a byte
         target = ref.parts[0].data[1].locations[0].target
-        raw = bytearray(open(target, "rb").read())
+        raw = bytearray(pathlib.Path(target).read_bytes())
         raw[0] ^= 0xFF
-        open(target, "wb").write(bytes(raw))
+        pathlib.Path(target).write_bytes(bytes(raw))
         report = await ref.verify()
         assert report.integrity().name == "DEGRADED"
 

@@ -5,6 +5,7 @@ path on TPU)."""
 
 import asyncio
 import os
+import pathlib
 import random
 
 import numpy as np
@@ -64,7 +65,7 @@ def test_jax_backend_cluster_lifecycle(tmp_path):
         # shards on disk are byte-identical to the numpy oracle: re-derive
         # parity from the stored data chunks and compare hashes
         part = ref.parts[0]
-        data_rows = [np.frombuffer(open(c.locations[0].target, "rb").read(),
+        data_rows = [np.frombuffer(pathlib.Path(c.locations[0].target).read_bytes(),
                                    dtype=np.uint8) for c in part.data]
         oracle = ErasureCoder(len(part.data), len(part.parity),
                               NumpyBackend())
@@ -129,12 +130,12 @@ def test_wide_stripe_mesh_cluster_lifecycle(tmp_path):
         ref = await cluster.get_file_ref("w")
         # oracle byte-identity of one part's parity
         part = ref.parts[0]
-        data_rows = [np.frombuffer(open(c.locations[0].target, "rb").read(),
+        data_rows = [np.frombuffer(pathlib.Path(c.locations[0].target).read_bytes(),
                                    dtype=np.uint8) for c in part.data]
         oracle = ErasureCoder(len(part.data), len(part.parity),
                               NumpyBackend())
         want_parity = oracle.encode_batch(np.stack(data_rows)[None])[0]
-        got_parity = [open(c.locations[0].target, "rb").read()
+        got_parity = [pathlib.Path(c.locations[0].target).read_bytes()
                       for c in part.parity]
         for w, g in zip(want_parity, got_parity):
             assert w.tobytes() == g
