@@ -618,6 +618,104 @@ def bench_batched_repair() -> None:
     }))
 
 
+def bench_hot_read(argv=()) -> None:
+    """Hot-read serve path: repeated reads of ONE object through the full
+    cluster read pipeline (metadata -> FileReadBuilder -> chunk fetch +
+    verify), cache off vs on (`tunables.cache_bytes`).  The off run pays
+    fetch + SHA-256 verify per chunk every time; the on run serves
+    verified buffers out of the content-addressed cache.  CPU-backend,
+    no device, no watchdog.  Single JSON line: value = cached GiB/s,
+    with the uncached number and the speedup alongside.
+
+    Flags: ``--mib N`` object size (default 64), ``--reads N`` timed
+    reads per mode (default 5), ``--backend X`` (default auto)."""
+    import asyncio
+    import contextlib
+    import tempfile
+
+    argv = list(argv)
+
+    def flag(name, default, cast):
+        if name in argv:
+            return cast(argv[argv.index(name) + 1])
+        return default
+
+    mib = flag("--mib", 64, int)
+    reads = flag("--reads", 5, int)
+    backend = flag("--backend", None, str)
+
+    from chunky_bits_tpu.cluster import Cluster
+    from chunky_bits_tpu.utils import aio
+
+    payload = np.random.default_rng(0).integers(
+        0, 256, mib << 20, dtype=np.uint8).tobytes()
+
+    def make_cluster(root: str, cache_bytes: int) -> Cluster:
+        import os
+
+        dirs = []
+        for i in range(5):
+            d = os.path.join(root, f"disk{i}")
+            os.makedirs(d, exist_ok=True)
+            dirs.append(d)
+        meta = os.path.join(root, "meta")
+        os.makedirs(meta, exist_ok=True)
+        tunables = {"cache_bytes": cache_bytes}
+        if backend:
+            tunables["backend"] = backend
+        return Cluster.from_obj({
+            "destinations": [{"location": d} for d in dirs],
+            "metadata": {"type": "path", "format": "yaml", "path": meta},
+            "profiles": {"default": {"data": 3, "parity": 2,
+                                     "chunk_size": 20}},
+            "tunables": tunables,
+        })
+
+    async def read_once(cluster: Cluster) -> int:
+        # the gateway GET core: metadata ref (cached or parsed) then the
+        # serve-path builder's stream
+        ref = await cluster.get_file_ref("obj")
+        total = 0
+        async for chunk in cluster.file_read_builder(ref).stream():
+            total += len(chunk)
+        return total
+
+    async def run_mode(root: str, cache_bytes: int) -> float:
+        cluster = make_cluster(root, cache_bytes)
+        profile = cluster.get_profile(None)
+        await cluster.write_file("obj", aio.BytesReader(payload), profile)
+        # warm pass doubles as the byte-identity gate for this mode
+        ref = await cluster.get_file_ref("obj")
+        got = await cluster.file_read_builder(ref).read_all()
+        assert got == payload, "hot-read byte identity failed"
+        best = float("inf")
+        for _ in range(reads):
+            t0 = time.perf_counter()
+            n = await read_once(cluster)
+            best = min(best, time.perf_counter() - t0)
+            assert n == len(payload)
+        await cluster.tunables.location_context().aclose()
+        return len(payload) / best / (1 << 30)
+
+    with contextlib.ExitStack() as stack:
+        cold_root = stack.enter_context(tempfile.TemporaryDirectory())
+        hot_root = stack.enter_context(tempfile.TemporaryDirectory())
+        uncached = asyncio.run(run_mode(cold_root, 0))
+        cached = asyncio.run(run_mode(hot_root, max(4 * len(payload),
+                                                    64 << 20)))
+    speedup = cached / uncached if uncached > 0 else 0.0
+    print(f"# config 6: hot-read of one {mib} MiB object, d=3 p=2, "
+          f"backend={backend or 'auto'}; uncached {uncached:.2f} GiB/s, "
+          f"cached {cached:.2f} GiB/s ({speedup:.1f}x)", file=sys.stderr)
+    print(json.dumps({
+        "metric": "hot_read_cached_gibps_d3p2_1mib",
+        "value": round(cached, 2), "unit": "GiB/s",
+        "vs_baseline": round(cached / 5.0, 2),
+        "uncached_gibps": round(uncached, 2),
+        "cache_speedup": round(speedup, 2),
+    }))
+
+
 def bench_small_objects(argv=()) -> None:
     """BASELINE.md config 4's compute core: many concurrent small-object
     encodes (d=8 p=3, 4 MiB objects => [1, 8, S] batches) coalescing
@@ -709,13 +807,15 @@ if __name__ == "__main__":
         configs = {"1": bench_cpu_reference,
                    "2": lambda: bench_cp_pipeline(sys.argv),
                    "3": bench_batched_repair,
-                   "4": lambda: bench_small_objects(sys.argv)}
+                   "4": lambda: bench_small_objects(sys.argv),
+                   "6": lambda: bench_hot_read(sys.argv)}
         idx = sys.argv.index("--config") + 1
         which = sys.argv[idx] if idx < len(sys.argv) else ""
         if which not in configs:
-            print(f"usage: bench.py [--config {{1,2,3,4}}] — the device "
+            print(f"usage: bench.py [--config {{1,2,3,4,6}}] — the device "
                   f"kernel metric (configs 2+3's compute core) is the "
-                  f"default no-arg run (got {which!r})", file=sys.stderr)
+                  f"default no-arg run (got {which!r}); 6 is the "
+                  f"hot-read cache A/B", file=sys.stderr)
             sys.exit(2)
         configs[which]()
     else:

@@ -121,13 +121,12 @@ class ClusterLocation:
     async def get_reader(self, config) -> aio.AsyncByteReader:
         if self.kind in ("cluster", "file_ref"):
             file_ref = await self._load_file_ref(config)
-            builder = FileReadBuilder(file_ref)
             if self.kind == "cluster":
+                # the cluster's serve-path builder: shared reconstruct
+                # batcher + (when tuned on) the content-addressed cache
                 cluster = await config.get_cluster(self.cluster)
-                builder = builder.location_context(
-                    cluster.tunables.location_context()
-                ).with_backend(cluster.tunables.backend)
-            return builder.reader()
+                return cluster.file_read_builder(file_ref).reader()
+            return FileReadBuilder(file_ref).reader()
         if self.kind == "other":
             return await self.location.reader()
         return _StdinReader()

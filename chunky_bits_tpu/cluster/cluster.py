@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import weakref
+from collections import OrderedDict
 from typing import Optional, Union
 
 from chunky_bits_tpu.cluster.destination import Destination
@@ -31,6 +32,7 @@ from chunky_bits_tpu.errors import SerdeError
 from chunky_bits_tpu.file.file_reference import FileReference
 from chunky_bits_tpu.file.location import Location
 from chunky_bits_tpu.file.profiler import ProfileReport, new_profiler
+from chunky_bits_tpu.file.reader import FileReadBuilder
 from chunky_bits_tpu.file.writer import FileWriteBuilder
 from chunky_bits_tpu.utils import aio
 
@@ -46,6 +48,15 @@ class Cluster:
         self.tunables = tunables or Tunables()
         # per-event-loop shared encode batchers (see _encode_batcher)
         self._encode_batchers = weakref.WeakKeyDictionary()
+        # per-event-loop shared reconstruct batchers and read caches
+        # (see _reconstruct_batcher / _chunk_cache)
+        self._reconstruct_batchers = weakref.WeakKeyDictionary()
+        self._chunk_caches = weakref.WeakKeyDictionary()
+        # FileReference metadata cache (path -> parsed ref), LRU-bounded,
+        # active only when the read cache is on; _file_ref_gen fences a
+        # read that was in flight across a write of the same path
+        self._file_refs: "OrderedDict[str, FileReference]" = OrderedDict()
+        self._file_ref_gen = 0
 
     # ---- serde ----
 
@@ -152,7 +163,18 @@ class Cluster:
 
     async def write_file_ref(self, path: str,
                              file_ref: FileReference) -> None:
-        await self.metadata.write(path, file_ref.to_obj())
+        # invalidate around BOTH edges of the durable write: the bump
+        # before it fences get_file_ref calls already parsing the old
+        # bytes, and the bump after it fences calls that started DURING
+        # the write (new generation snapshot, old on-disk bytes) — either
+        # way a stale parse can never be re-inserted
+        self._file_ref_gen += 1
+        self._file_refs.pop(path, None)
+        try:
+            await self.metadata.write(path, file_ref.to_obj())
+        finally:
+            self._file_ref_gen += 1
+            self._file_refs.pop(path, None)
 
     async def write_file(self, path: str, reader: aio.AsyncByteReader,
                          profile: ClusterProfile,
@@ -178,14 +200,74 @@ class Cluster:
 
     # ---- read path ----
 
+    #: FileReference cache bound (entries, not bytes: a parsed ref is
+    #: tiny next to the chunk buffers the byte budget governs)
+    FILE_REF_CACHE_ENTRIES = 1024
+
+    def _reconstruct_batcher(self):
+        """Per-event-loop shared ReconstructBatcher, mirroring
+        ``_encode_batcher``: concurrent degraded GETs (and resilver-like
+        readers) coalesce into single batched reconstruct dispatches
+        instead of one batcher per read stream.  Shared for every
+        backend — the decode-layout stacking wins on CPU too (BASELINE
+        config 3) — and never aclosed: it owns no OS resources, and its
+        in-flight dispatch tasks finish with the reads that await them."""
+        loop = asyncio.get_running_loop()
+        batcher = self._reconstruct_batchers.get(loop)
+        if batcher is None:
+            from chunky_bits_tpu.ops.batching import ReconstructBatcher
+
+            batcher = ReconstructBatcher(backend=self.tunables.backend)
+            self._reconstruct_batchers[loop] = batcher
+        return batcher
+
+    def _chunk_cache(self):
+        """Per-event-loop content-addressed read cache, or None when the
+        ``cache_bytes`` tunable leaves it off (the default)."""
+        if self.tunables.cache_bytes <= 0:
+            return None
+        loop = asyncio.get_running_loop()
+        cache = self._chunk_caches.get(loop)
+        if cache is None:
+            from chunky_bits_tpu.file.chunk_cache import ChunkCache
+
+            cache = ChunkCache(self.tunables.cache_bytes)
+            self._chunk_caches[loop] = cache
+        return cache
+
     async def get_file_ref(self, path: str) -> FileReference:
+        cache_on = self.tunables.cache_bytes > 0
+        if cache_on:
+            ref = self._file_refs.get(path)
+            if ref is not None:
+                self._file_refs.move_to_end(path)
+                return ref
+        gen = self._file_ref_gen
         obj = await self.metadata.read(path)
-        return FileReference.from_obj(obj)
+        ref = FileReference.from_obj(obj)
+        # insert only if no write invalidated the cache while this read
+        # was in flight — otherwise we could durably cache a stale ref
+        if cache_on and gen == self._file_ref_gen:
+            self._file_refs[path] = ref
+            while len(self._file_refs) > self.FILE_REF_CACHE_ENTRIES:
+                self._file_refs.popitem(last=False)
+        return ref
+
+    def file_read_builder(self, file_ref: FileReference) -> FileReadBuilder:
+        """The serve-path read builder: cluster context, backend, the
+        per-loop shared reconstruct batcher, and (when enabled) the
+        chunk cache.  The gateway and ``read_file`` both come through
+        here so every GET shares the same coalescing and cache."""
+        return (
+            file_ref.read_builder(self.tunables.location_context())
+            .with_backend(self.tunables.backend)
+            .with_batcher(self._reconstruct_batcher())
+            .with_cache(self._chunk_cache())
+        )
 
     async def read_file(self, path: str) -> aio.AsyncByteReader:
         file_ref = await self.get_file_ref(path)
-        builder = file_ref.read_builder(self.tunables.location_context())
-        return builder.with_backend(self.tunables.backend).reader()
+        return self.file_read_builder(file_ref).reader()
 
     async def list_files(self, path: str = ".") -> list[FileOrDirectory]:
         return await self.metadata.list(path)

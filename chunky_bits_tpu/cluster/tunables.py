@@ -9,15 +9,36 @@ north-star's cluster.yaml switch between cpu and TPU erasure backends).
 ``backend`` names: ``numpy`` / ``native`` (C++, all host cores) /
 ``native:4`` (C++ capped at 4 threads) / ``jax`` (single device) /
 ``jax:dp4,sp2`` / ``jax:tp4`` (device-mesh sharded; parallel/backend.py).
+
+``cache_bytes`` (TPU-repo extension, default 0 = off per the
+measure-before-defaulting invariant) budgets the content-addressed read
+cache on the serve path: verified chunk buffers keyed by sha256 digest,
+plus the cluster's FileReference metadata cache.  YAML wins; the
+``CHUNKY_BITS_TPU_CACHE_BYTES`` env var supplies the default so an
+operator can turn the cache on without editing cluster.yaml.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import os
+from dataclasses import dataclass, field
 from typing import Optional
 
 from chunky_bits_tpu.errors import SerdeError
 from chunky_bits_tpu.file.location import IGNORE, OVERWRITE, LocationContext
+
+CACHE_BYTES_ENV = "CHUNKY_BITS_TPU_CACHE_BYTES"
+
+
+def _default_cache_bytes() -> int:
+    """Env-supplied default; malformed or negative values read as off
+    (the knob can only *enable*, never crash, config loading)."""
+    raw = os.environ.get(CACHE_BYTES_ENV, "")
+    try:
+        v = int(raw)
+    except ValueError:
+        return 0
+    return max(v, 0)
 
 
 @dataclass
@@ -26,6 +47,9 @@ class Tunables:
     on_conflict: str = IGNORE
     user_agent: Optional[str] = None
     backend: Optional[str] = None  # erasure backend name (None = auto)
+    #: read-cache byte budget; 0 disables (the default — opt-in until
+    #: measured, per CLAUDE.md)
+    cache_bytes: int = field(default_factory=_default_cache_bytes)
 
     def is_device_backend(self) -> bool:
         """True when the erasure plane runs on an accelerator ("jax" or a
@@ -49,11 +73,23 @@ class Tunables:
         on_conflict = obj.get("on_conflict", IGNORE)
         if on_conflict not in (IGNORE, OVERWRITE):
             raise SerdeError(f"invalid on_conflict {on_conflict!r}")
+        cache_bytes = obj.get("cache_bytes", None)
+        if cache_bytes is not None:
+            try:
+                cache_bytes = int(cache_bytes)
+            except (TypeError, ValueError) as err:
+                raise SerdeError(
+                    f"invalid cache_bytes {cache_bytes!r}") from err
+            if cache_bytes < 0:
+                raise SerdeError(
+                    f"cache_bytes must be >= 0, got {cache_bytes}")
         return cls(
             https_only=bool(obj.get("https_only", False)),
             on_conflict=on_conflict,
             user_agent=obj.get("user_agent"),
             backend=obj.get("backend"),
+            **({"cache_bytes": cache_bytes}
+               if cache_bytes is not None else {}),
         )
 
     def to_obj(self) -> dict:
@@ -64,6 +100,8 @@ class Tunables:
         }
         if self.backend is not None:
             obj["backend"] = self.backend
+        if self.cache_bytes > 0:
+            obj["cache_bytes"] = self.cache_bytes
         return obj
 
     def location_context(self) -> LocationContext:

@@ -1,6 +1,10 @@
 """File codec and I/O substrate (the reference's src/file/ layer)."""
 
 from chunky_bits_tpu.file.chunk import Chunk  # noqa: F401
+from chunky_bits_tpu.file.chunk_cache import (  # noqa: F401
+    CacheStats,
+    ChunkCache,
+)
 from chunky_bits_tpu.file.collection_destination import (  # noqa: F401
     CollectionDestination,
     LocationsDestination,

@@ -20,6 +20,15 @@ class Chunk:
     hash: AnyHash
     locations: list[Location] = field(default_factory=list)
 
+    def cache_key(self) -> "bytes | None":
+        """Key for the content-addressed read cache: the raw sha256
+        digest, or None for any future non-sha256 algorithm (those
+        chunks simply bypass the cache rather than risk a key clash
+        across hash domains)."""
+        if self.hash.algorithm != "sha256":
+            return None
+        return self.hash.value.digest
+
     def to_obj(self) -> dict:
         return {
             self.hash.algorithm: self.hash.value.hex(),

@@ -186,16 +186,16 @@ class FilePart:
     async def read(self, cx: Optional[LocationContext] = None,
                    coder: Optional[ErasureCoder] = None,
                    backend: Optional[str] = None,
-                   batcher=None) -> bytes:
+                   batcher=None, cache=None) -> bytes:
         """``read_buffers`` joined into one bytes object (padding
         included; the file reader trims)."""
         return b"".join(
-            await self.read_buffers(cx, coder, backend, batcher))
+            await self.read_buffers(cx, coder, backend, batcher, cache))
 
     async def read_buffers(self, cx: Optional[LocationContext] = None,
                            coder: Optional[ErasureCoder] = None,
                            backend: Optional[str] = None,
-                           batcher=None) -> list:
+                           batcher=None, cache=None) -> list:
         """Scattered read: d workers randomly grab chunks from the shared
         d+p pool, falling through each chunk's locations; RS-reconstruct if
         any data chunk is missing.  Returns the d data-chunk buffers in
@@ -206,10 +206,30 @@ class FilePart:
 
         ``batcher`` (an ops.batching.ReconstructBatcher) coalesces this
         part's reconstruction with other parts in flight into one device
-        dispatch."""
+        dispatch.
+
+        ``cache`` (a file.chunk_cache.ChunkCache) short-circuits fetch
+        AND verify for chunks whose verified bytes it already holds:
+        hits pre-fill their slots before any worker spawns, misses fetch
+        through the cache's singleflight (concurrent readers of one
+        digest share a single fetch), and whole verified buffers —
+        never trimmed ranges — are what gets inserted."""
         cx = cx or default_context()
+        if cache is not None and cx.profiler is not None:
+            # a cache hit produces no read log entry at all, so the
+            # profiler surfaces the cache's own counters instead
+            cx.profiler.attach_cache(cache)
         d, p = len(self.data), len(self.parity)
-        pool: list[tuple[int, Chunk]] = list(enumerate(self.all_chunks()))
+        slots: list[Optional[bytes]] = [None] * (d + p)
+        pool: list[tuple[int, Chunk]] = []
+        for index, chunk in enumerate(self.all_chunks()):
+            buf = (cache.get(chunk.cache_key())
+                   if cache is not None and chunk.cache_key() is not None
+                   else None)
+            if buf is not None:
+                slots[index] = buf
+            else:
+                pool.append((index, chunk))
         pool_lock = asyncio.Lock()
 
         async def read_verified(chunk: Chunk, location
@@ -237,6 +257,18 @@ class FilePart:
                 data = await _read_chunk_payload(location, cx)
             return (await chunk.hash.verify_async(data), data)
 
+        async def fetch_chunk(chunk: Chunk):
+            """First verified buffer across the chunk's locations, or
+            None when every location is unreadable/corrupt."""
+            for location in chunk.locations:
+                try:
+                    ok, data = await read_verified(chunk, location)
+                except LocationError:
+                    continue
+                if ok:
+                    return data
+            return None
+
         async def worker() -> Optional[tuple[int, bytes]]:
             while True:
                 async with pool_lock:
@@ -244,16 +276,19 @@ class FilePart:
                         return None
                     idx = random.randrange(len(pool))
                     index, chunk = pool.pop(idx)
-                for location in chunk.locations:
-                    try:
-                        ok, data = await read_verified(chunk, location)
-                    except LocationError:
-                        continue
-                    if ok:
-                        return (index, data)
+                key = chunk.cache_key() if cache is not None else None
+                if key is not None:
+                    data = await cache.get_or_fetch(
+                        key, lambda c=chunk: fetch_chunk(c))
+                else:
+                    data = await fetch_chunk(chunk)
+                if data is not None:
+                    return (index, data)
 
-        results = await asyncio.gather(*[worker() for _ in range(d)])
-        slots: list[Optional[bytes]] = [None] * (d + p)
+        # cache hits above already filled some slots; only the shortfall
+        # needs workers (a fully hot part spawns none at all)
+        needed = max(d - sum(1 for s in slots if s is not None), 0)
+        results = await asyncio.gather(*[worker() for _ in range(needed)])
         for item in results:
             if item is not None:
                 slots[item[0]] = item[1]
@@ -263,6 +298,7 @@ class FilePart:
                 raise NotEnoughChunks(
                     f"only {present} of {d}+{p} chunks readable"
                 )
+            rebuilt_idx = [i for i in range(d) if slots[i] is None]
             arrays: list[Optional[np.ndarray]] = [
                 np.frombuffer(s, dtype=np.uint8) if s is not None else None
                 for s in slots
@@ -275,6 +311,13 @@ class FilePart:
             slots = [memoryview(np.ascontiguousarray(a))
                      if isinstance(a, np.ndarray) else a
                      for a in arrays]
+            if cache is not None:
+                # reconstructed rows were never hash-verified, so they
+                # enter through the verify-then-insert gate; a repeated
+                # degraded read then hits instead of re-decoding
+                for i in rebuilt_idx:
+                    await cache.insert_verified(self.data[i].hash,
+                                                slots[i])
         return [slots[i] for i in range(d)]  # type: ignore[misc]
 
     # ---- encode (pure compute half; no I/O) ----

@@ -38,6 +38,21 @@ class Profiler:
     def __init__(self) -> None:
         self._entries: list[ResultLog] = []
         self._lock = threading.Lock()
+        self._caches: list = []  # read caches whose counters we surface
+
+    def attach_cache(self, cache) -> None:
+        """Register a chunk cache so its hit/miss/eviction/singleflight
+        counters ride along in the report — cache hits never reach the
+        read hooks, so without this a fully hot read profiles as zero
+        I/O and zero everything else."""
+        with self._lock:
+            if all(c is not cache for c in self._caches):
+                self._caches.append(cache)
+
+    def cache_stats(self) -> list:
+        """Snapshot of each attached cache's counters (CacheStats)."""
+        with self._lock:
+            return [c.stats() for c in self._caches]
 
     def log_read(self, ok: bool, error: Optional[str], location,
                  length: int, start_time: float) -> None:
@@ -60,8 +75,9 @@ class Profiler:
 
 
 class ProfileReport:
-    def __init__(self, entries: list[ResultLog]):
+    def __init__(self, entries: list[ResultLog], cache_stats: list = ()):
         self.entries = entries
+        self.cache_stats = list(cache_stats)
 
     def _avg(self, kind: str) -> Optional[float]:
         durations = [e.duration for e in self.entries if e.kind == kind]
@@ -87,11 +103,14 @@ class ProfileReport:
         def ms(v: Optional[float]) -> str:
             return "None" if v is None else str(int(v * 1000))
 
-        return (
+        base = (
             f"ReadAvg<{ms(self.average_read_duration())}ms> "
             f"WriteAvg<{ms(self.average_write_duration())}ms> "
             f"Total<{ms(self.total_time())}ms> Total<{self.total_bytes()}B>"
         )
+        for stats in self.cache_stats:
+            base += f" {stats}"
+        return base
 
 
 class ProfileReporter:
@@ -101,7 +120,8 @@ class ProfileReporter:
         self._profiler = profiler
 
     def profile(self) -> ProfileReport:
-        return ProfileReport(self._profiler.drain())
+        return ProfileReport(self._profiler.drain(),
+                             self._profiler.cache_stats())
 
 
 def new_profiler() -> tuple[Profiler, ProfileReporter]:

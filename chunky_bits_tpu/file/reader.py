@@ -30,9 +30,23 @@ class FileReadBuilder:
     seek: int = 0
     take: int = 0
     backend: Optional[str] = None  # erasure backend for reconstruction
+    #: shared ReconstructBatcher (e.g. the cluster's per-loop instance,
+    #: so concurrent GETs coalesce into one device dispatch); when None
+    #: the stream creates — and owns closing — its own
+    batcher: Optional[object] = None
+    #: content-addressed read cache (file.chunk_cache.ChunkCache); hits
+    #: skip fetch + verify, and whole verified chunks are what's cached
+    #: even under seek/take (trimming happens here, at the edge)
+    cache: Optional[object] = None
 
     def with_backend(self, backend: Optional[str]) -> "FileReadBuilder":
         return replace(self, backend=backend)
+
+    def with_batcher(self, batcher) -> "FileReadBuilder":
+        return replace(self, batcher=batcher)
+
+    def with_cache(self, cache) -> "FileReadBuilder":
+        return replace(self, cache=cache)
 
     def with_seek(self, seek: int) -> "FileReadBuilder":
         return replace(self, seek=seek)
@@ -75,10 +89,16 @@ class FileReadBuilder:
 
         The prefetched parts share one ReconstructBatcher, so a degraded
         read of many parts rebuilds its missing shards in batched device
-        dispatches instead of one per part."""
+        dispatches instead of one per part.  A builder-provided batcher
+        (the cluster's per-loop shared instance) additionally coalesces
+        across concurrent streams and is NOT closed here — it outlives
+        any one read the way the cluster's encode batcher does."""
         from chunky_bits_tpu.ops.batching import ReconstructBatcher
 
-        batcher = ReconstructBatcher(backend=self.backend)
+        batcher = self.batcher
+        owns_batcher = batcher is None
+        if owns_batcher:
+            batcher = ReconstructBatcher(backend=self.backend)
         remaining = self.len_bytes()
         jobs: list[tuple[FilePart, int]] = []
         seek = self.seek
@@ -121,14 +141,16 @@ class FileReadBuilder:
                 t.cancel()
             if tasks:
                 await asyncio.gather(*tasks, return_exceptions=True)
-            await batcher.aclose()
+            if owns_batcher:
+                await batcher.aclose()
 
     async def _read_part(self, part: FilePart, skip: int,
                          batcher=None) -> list:
         # backend resolution happens lazily inside part.read_buffers,
         # only when reconstruction is actually needed
         buffers = await part.read_buffers(self.cx, backend=self.backend,
-                                          batcher=batcher)
+                                          batcher=batcher,
+                                          cache=self.cache)
         if not skip:
             return buffers
         out = []

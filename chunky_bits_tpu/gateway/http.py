@@ -24,7 +24,6 @@ from aiohttp import web
 
 from chunky_bits_tpu.cluster import Cluster
 from chunky_bits_tpu.errors import ChunkyBitsError, MetadataReadError
-from chunky_bits_tpu.file import FileReadBuilder
 from chunky_bits_tpu.utils import aio
 
 log = logging.getLogger("chunky_bits_tpu.gateway")
@@ -127,7 +126,6 @@ def make_app(cluster: Cluster,
              max_concurrent_puts: int = DEFAULT_MAX_CONCURRENT_PUTS,
              min_put_rate: int = DEFAULT_MIN_PUT_RATE
              ) -> web.Application:
-    cx = cluster.tunables.location_context()
     # <=0 means unbounded, like the reference's ingest (and matching
     # min_put_rate's "0 disables" convention)
     put_sem = (asyncio.Semaphore(max_concurrent_puts)
@@ -144,7 +142,13 @@ def make_app(cluster: Cluster,
             # node URLs / filesystem paths untrusted clients must not see
             log.error("GET %s failed: %s", path, err)
             return web.Response(status=500, text="error: internal error\n")
-        builder = FileReadBuilder(file_ref).location_context(cx)
+        # the cluster's serve-path builder: per-loop shared reconstruct
+        # batcher (concurrent degraded GETs coalesce their decode
+        # dispatches) and, when `tunables.cache_bytes` is set, the
+        # content-addressed chunk cache.  Range requests ride the same
+        # path: the cache only ever holds whole verified chunks — the
+        # seek/take trim below happens at the edge, after the cache.
+        builder = cluster.file_read_builder(file_ref)
         status = 200
         headers = {}
         range_header = request.headers.get("Range")
@@ -203,6 +207,17 @@ def make_app(cluster: Cluster,
         await resp.write_eof()
         return resp
 
+    def put_reject(status: int, text: str) -> web.Response:
+        """An error response for a PUT whose body was not (fully) read.
+        The connection is force-closed: answering early and then reusing
+        the keep-alive stream leaves the unread body bytes in front of
+        the next request's head — observed as the follow-up request
+        hanging forever against aiohttp 3.11's client, which returns the
+        half-sent connection to its pool once the early response lands."""
+        resp = web.Response(status=status, text=text)
+        resp.force_close()
+        return resp
+
     async def handle_put(request: web.Request) -> web.Response:
         path = request.match_info["path"]
         profile = cluster.get_profile(None)
@@ -211,8 +226,7 @@ def make_app(cluster: Cluster,
         if max_put_bytes is not None:
             declared = request.headers.get("Content-Length")
             if declared is not None and int(declared) > max_put_bytes:
-                return web.Response(status=413,
-                                    text="error: body too large\n")
+                return put_reject(413, "error: body too large\n")
 
         # A rejected/aborted ingest can leave orphaned shards; they are
         # content-addressed (possibly shared with other files), so they
@@ -226,15 +240,12 @@ def make_app(cluster: Cluster,
                                  min_put_rate),
                     profile, content_type)
             except _BodyTooLarge:
-                return web.Response(status=413,
-                                    text="error: body too large\n")
+                return put_reject(413, "error: body too large\n")
             except _BodyTooSlow:
-                return web.Response(status=408,
-                                    text="error: ingest too slow\n")
+                return put_reject(408, "error: ingest too slow\n")
             except ChunkyBitsError as err:
                 log.error("PUT %s failed: %s", path, err)
-                return web.Response(status=500,
-                                    text="error: internal error\n")
+                return put_reject(500, "error: internal error\n")
         return web.Response(status=200)
 
     app = web.Application()

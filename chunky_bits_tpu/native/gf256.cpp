@@ -66,17 +66,26 @@ const bool kInited = init_tables();
 // byte (empirically probed + verified on this convention), so the matrix
 // qword for constant c packs bit (7-k) of c*2^j at byte k, bit j.
 
-// Compiler gate, not just arch: __builtin_cpu_supports("gfni") only
-// exists from GCC 11 / clang 10 — on older toolchains the whole GFNI
+// Compiler gate, not just arch: the GFNI intrinsics + target attribute
+// need GCC 10 / clang 10 here — on older toolchains the whole GFNI
 // block must vanish or the native build (and with it the default
-// backend) silently degrades to numpy.
+// backend) silently degrades to numpy.  Runtime detection of the GFNI
+// *feature* goes through raw CPUID below, because
+// __builtin_cpu_supports("gfni") itself only parses from GCC 11.
 #if defined(__x86_64__) && \
     ((defined(__clang__) && __clang_major__ >= 10) || \
-     (!defined(__clang__) && defined(__GNUC__) && __GNUC__ >= 11))
+     (!defined(__clang__) && defined(__GNUC__) && __GNUC__ >= 10))
 #define CB_HAVE_GFNI 1
 #endif
 
 #ifdef CB_HAVE_GFNI
+#include <cpuid.h>
+bool cpu_has_gfni() {
+    unsigned int eax = 0, ebx = 0, ecx = 0, edx = 0;
+    if (!__get_cpuid_count(7, 0, &eax, &ebx, &ecx, &edx)) return false;
+    return (ecx >> 8) & 1u;  // CPUID.(7,0):ECX.GFNI[bit 8]
+}
+
 uint64_t GFNI_MAT[256];
 
 uint64_t gfni_matrix(uint8_t c) {
@@ -112,10 +121,13 @@ bool gfni_self_test() {
 }
 
 bool init_gfni() {
+    // avx512* go through the builtin (it checks OS XSAVE state too, and
+    // those names parse on every toolchain that passed the gate above);
+    // only "gfni" needs the raw-CPUID fallback.
     if (!(__builtin_cpu_supports("avx512f")
           && __builtin_cpu_supports("avx512bw")
           && __builtin_cpu_supports("avx512vl")
-          && __builtin_cpu_supports("gfni")))
+          && cpu_has_gfni()))
         return false;
     for (int c = 0; c < 256; c++)
         GFNI_MAT[c] = gfni_matrix(static_cast<uint8_t>(c));
@@ -302,6 +314,17 @@ void transform_scalar(uint32_t* st, const uint8_t* p, size_t blocks) {
 
 #if defined(__x86_64__) && defined(__GNUC__)
 #define CB_HAVE_SHANI 1
+#include <cpuid.h>
+// Runtime SHA-NI detection via raw CPUID (leaf 7, EBX bit 29).  The
+// obvious __builtin_cpu_supports("sha") only parses from GCC 11 — on
+// GCC 10 that builtin is a hard compile error that takes the whole
+// native build (and the default backend) down with it, hence this
+// hand-rolled check.
+bool cpu_has_shani() {
+    unsigned int eax = 0, ebx = 0, ecx = 0, edx = 0;
+    if (!__get_cpuid_count(7, 0, &eax, &ebx, &ecx, &edx)) return false;
+    return (ebx >> 29) & 1u;
+}
 // Intel SHA extensions path; layout (ABEF/CDGH packing, per-4-round
 // message recurrence) follows the standard published pattern.
 __attribute__((target("sha,sse4.1,ssse3")))
@@ -452,7 +475,7 @@ using TransformFn = void (*)(uint32_t*, const uint8_t*, size_t);
 
 TransformFn pick_transform() {
 #ifdef CB_HAVE_SHANI
-    if (__builtin_cpu_supports("sha")) return transform_shani;
+    if (cpu_has_shani()) return transform_shani;
 #endif
     return transform_scalar;
 }
@@ -464,7 +487,7 @@ using Transform2Fn = void (*)(uint32_t*, const uint8_t*,
 
 Transform2Fn pick_transform2() {
 #ifdef CB_HAVE_SHANI
-    if (__builtin_cpu_supports("sha")) return transform_shani_x2;
+    if (cpu_has_shani()) return transform_shani_x2;
 #endif
     return nullptr;
 }

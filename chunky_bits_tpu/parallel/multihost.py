@@ -32,6 +32,24 @@ __all__ = ["init_multihost", "local_mesh", "local_stripe_mesh",
 _INITIALIZED = False
 
 
+def _distributed_initialized(jax) -> bool:
+    """Whether jax.distributed.initialize already ran in this process.
+    ``jax.distributed.is_initialized`` only exists from jax 0.4.39; on
+    older builds (0.4.37 here) the equivalent signal is the private
+    global state's live client — reached defensively so an internals
+    reshuffle degrades to "not initialized" rather than an error."""
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        return bool(is_init())
+    try:
+        from jax._src import distributed as _dist
+
+        state = getattr(_dist, "global_state", None)
+        return getattr(state, "client", None) is not None
+    except Exception:
+        return False
+
+
 def init_multihost(coordinator_address: Optional[str] = None,
                    num_processes: Optional[int] = None,
                    process_id: Optional[int] = None,
@@ -64,7 +82,7 @@ def init_multihost(coordinator_address: Optional[str] = None,
     env_coordinator = (os.environ.get("JAX_COORDINATOR_ADDRESS")
                        or os.environ.get("COORDINATOR_ADDRESS"))
 
-    if jax.distributed.is_initialized():
+    if _distributed_initialized(jax):
         _INITIALIZED = True
         return jax.process_index(), jax.process_count()
 

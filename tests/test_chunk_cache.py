@@ -1,0 +1,440 @@
+"""Content-addressed read cache + cluster-shared reconstruct pipeline.
+
+The reference has no read-side caching (every GET re-fetches, re-verifies,
+re-decodes; src/file/file_part.rs:73-135) so there is nothing to mirror —
+these tests pin the TPU-repo extension's own contract: byte identity with
+the cache on vs off (including reconstruct-from-erasure hits), singleflight
+under concurrent readers, LRU eviction under a tiny byte budget, rejection
+of corrupted pre-insert buffers, whole-chunk-only entries under ranged
+gateway GETs, and the per-loop shared reconstruct batcher / FileReference
+metadata cache on the cluster façade.
+"""
+
+import asyncio
+import hashlib
+import os
+import random
+
+import pytest
+
+from chunky_bits_tpu.cluster import Cluster
+from chunky_bits_tpu.cluster.tunables import CACHE_BYTES_ENV, Tunables
+from chunky_bits_tpu.errors import SerdeError
+from chunky_bits_tpu.file import (
+    AnyHash,
+    ChunkCache,
+    FileReadBuilder,
+    FileReference,
+    LocationContext,
+    new_profiler,
+)
+from chunky_bits_tpu.utils import aio
+
+CHUNK_SIZE = 1 << 16
+
+
+def synthetic_bytes(n: int, seed: int = 0) -> bytes:
+    rng = random.Random(seed)
+    return bytes(rng.getrandbits(8) for _ in range(n))
+
+
+def make_cluster(tmp_path, cache_bytes: int = 0, backend=None) -> Cluster:
+    dirs = []
+    for i in range(5):
+        d = tmp_path / f"disk{i}"
+        d.mkdir(exist_ok=True)
+        dirs.append(str(d))
+    meta = tmp_path / "meta"
+    meta.mkdir(exist_ok=True)
+    tunables = {"cache_bytes": cache_bytes}
+    if backend is not None:
+        tunables["backend"] = backend
+    return Cluster.from_obj({
+        "destinations": [{"location": d} for d in dirs],
+        "metadata": {"type": "path", "format": "yaml", "path": str(meta)},
+        "profiles": {"default": {"data": 3, "parity": 2,
+                                 "chunk_size": 16}},
+        "tunables": tunables,
+    })
+
+
+async def read_all(cluster: Cluster, path: str) -> bytes:
+    reader = await cluster.read_file(path)
+    out = []
+    while True:
+        data = await reader.read(1 << 20)
+        if not data:
+            break
+        out.append(data)
+    return b"".join(out)
+
+
+# ---- unit: the cache itself ----
+
+
+def test_lru_eviction_under_byte_budget():
+    cache = ChunkCache(100)
+    bufs = {bytes([i]) * 32: bytes([i]) * 40 for i in range(3)}
+    for digest, buf in bufs.items():
+        assert cache._insert(digest, buf) is not None
+    # 3 x 40 > 100: the first (LRU) entry was evicted
+    assert cache.evictions == 1
+    assert cache.size_bytes == 80
+    assert len(cache) == 2
+    digests = list(bufs)
+    assert cache.get(digests[0]) is None
+    # freshen #1, insert another: #2 (now LRU) is the one to go
+    assert cache.get(digests[1]) == bufs[digests[1]]
+    assert cache._insert(b"x" * 32, b"y" * 40) is not None
+    assert cache.get(digests[2]) is None
+    assert cache.get(digests[1]) is not None
+    # an entry larger than the whole budget is refused outright
+    assert cache._insert(b"z" * 32, b"w" * 101) is None
+    assert cache.size_bytes <= 100
+
+
+def test_oversize_budget_rejected():
+    with pytest.raises(ValueError):
+        ChunkCache(0)
+
+
+def test_insert_verified_rejects_corruption():
+    async def main():
+        cache = ChunkCache(1 << 20)
+        good = b"payload-bytes"
+        hash_ = AnyHash.from_buf(good)
+        # a corrupted buffer under a mismatching digest never enters
+        assert not await cache.insert_verified(hash_, b"evil-bytes!!!")
+        assert cache.rejects == 1
+        assert len(cache) == 0
+        # the genuine bytes do
+        assert await cache.insert_verified(hash_, good)
+        assert cache.get(hash_.value.digest) == good
+
+    asyncio.run(main())
+
+
+def test_singleflight_concurrent_readers():
+    """N concurrent readers of one digest run ONE fetch; the losers are
+    served the winner's verified buffer."""
+    async def main():
+        cache = ChunkCache(1 << 20)
+        payload = b"c" * 1000
+        digest = hashlib.sha256(payload).digest()
+        fetches = {"n": 0}
+        gate = asyncio.Event()
+
+        async def fetch():
+            fetches["n"] += 1
+            await gate.wait()
+            return payload
+
+        tasks = [asyncio.ensure_future(cache.get_or_fetch(digest, fetch))
+                 for _ in range(8)]
+        await asyncio.sleep(0)  # all callers enqueue before the release
+        gate.set()
+        results = await asyncio.gather(*tasks)
+        assert all(r == payload for r in results)
+        assert fetches["n"] == 1
+        assert cache.misses == 1
+        assert cache.coalesced == 7
+        # and the buffer is now cached
+        assert cache.get(digest) == payload
+
+    asyncio.run(main())
+
+
+def test_singleflight_winner_death_does_not_doom_waiters():
+    """A cancelled winner hands the flight over: a waiter retries,
+    becomes the new winner, and completes the fetch."""
+    async def main():
+        cache = ChunkCache(1 << 20)
+        payload = b"d" * 64
+        digest = hashlib.sha256(payload).digest()
+        started = asyncio.Event()
+
+        async def hanging_fetch():
+            started.set()
+            await asyncio.Future()  # parked until cancelled
+
+        async def good_fetch():
+            return payload
+
+        winner = asyncio.ensure_future(
+            cache.get_or_fetch(digest, hanging_fetch))
+        await started.wait()
+        waiter = asyncio.ensure_future(
+            cache.get_or_fetch(digest, good_fetch))
+        await asyncio.sleep(0)
+        winner.cancel()
+        assert await waiter == payload
+        with pytest.raises(asyncio.CancelledError):
+            await winner
+
+    asyncio.run(main())
+
+
+def test_failed_fetch_propagates_none_to_waiters():
+    """A fetch that finds no readable location resolves every waiter
+    with None (chunk unreachable) — nobody re-fetches in a storm."""
+    async def main():
+        cache = ChunkCache(1 << 20)
+        digest = b"q" * 32
+        fetches = {"n": 0}
+        gate = asyncio.Event()
+
+        async def failing_fetch():
+            fetches["n"] += 1
+            await gate.wait()
+            return None
+
+        tasks = [asyncio.ensure_future(
+            cache.get_or_fetch(digest, failing_fetch)) for _ in range(4)]
+        await asyncio.sleep(0)
+        gate.set()
+        assert await asyncio.gather(*tasks) == [None] * 4
+        assert fetches["n"] == 1
+        assert len(cache) == 0
+
+    asyncio.run(main())
+
+
+# ---- conformance: byte identity with the cache in the loop ----
+
+
+@pytest.mark.parametrize("backend", ["numpy", "native", "jax"])
+def test_read_byte_identity_cache_on_vs_off(tmp_path, backend):
+    """Cached, uncached, and reconstruct-from-erasure reads are all
+    byte-identical across erasure backends — the cache can change
+    timing, never bytes."""
+    if backend == "native":
+        from chunky_bits_tpu.errors import ErasureError
+        from chunky_bits_tpu.ops.backend import get_backend
+
+        try:
+            get_backend("native")
+        except ErasureError as err:
+            pytest.skip(f"native backend unavailable: {err}")
+    if backend == "jax":
+        pytest.importorskip("jax")
+    payload = synthetic_bytes(3 * CHUNK_SIZE + 12345, seed=31)
+
+    async def main():
+        cold = make_cluster(tmp_path, cache_bytes=0, backend=backend)
+        profile = cold.get_profile(None)
+        await cold.write_file("obj", aio.BytesReader(payload), profile)
+        assert await read_all(cold, "obj") == payload
+
+        hot = make_cluster(tmp_path, cache_bytes=64 << 20, backend=backend)
+        assert await read_all(hot, "obj") == payload  # fill pass
+        cache = hot._chunk_caches[asyncio.get_running_loop()]
+        assert cache.misses > 0 and cache.inserts > 0
+        hits_before = cache.hits
+        assert await read_all(hot, "obj") == payload  # served hot
+        assert cache.hits > hits_before
+
+        # erase a data chunk: the cached read must still reconstruct
+        # byte-identically, and the rebuilt row becomes a cache entry
+        ref = await hot.get_file_ref("obj")
+        victim = ref.parts[0].data[1]
+        os.remove(victim.locations[0].target)
+        degraded = make_cluster(tmp_path, cache_bytes=64 << 20,
+                                backend=backend)
+        assert await read_all(degraded, "obj") == payload
+        dcache = degraded._chunk_caches[asyncio.get_running_loop()]
+        assert dcache.get(victim.cache_key()) is not None
+        # ...so the NEXT degraded read serves the lost chunk from cache
+        hits = dcache.hits
+        assert await read_all(degraded, "obj") == payload
+        assert dcache.hits > hits
+        for c in (cold, hot, degraded):
+            await c.tunables.location_context().aclose()
+
+    asyncio.run(main())
+
+
+def test_cache_never_holds_trimmed_buffers(tmp_path):
+    """Seek/take (range) reads fill the cache with WHOLE verified chunks
+    only; the trim happens at the stream edge."""
+    payload = synthetic_bytes(3 * CHUNK_SIZE + 5000, seed=5)
+
+    async def main():
+        cluster = make_cluster(tmp_path, cache_bytes=64 << 20)
+        profile = cluster.get_profile(None)
+        await cluster.write_file("obj", aio.BytesReader(payload), profile)
+        ref = await cluster.get_file_ref("obj")
+        builder = cluster.file_read_builder(ref)
+        got = await builder.with_seek(100).with_take(1000).read_all()
+        assert got == payload[100:1100]
+        cache = cluster._chunk_caches[asyncio.get_running_loop()]
+        sizes = {len(buf) for buf in cache._entries.values()}
+        # every entry is a whole chunk of the first part, never a slice
+        assert sizes == {ref.parts[0].chunksize}
+        await cluster.tunables.location_context().aclose()
+
+    asyncio.run(main())
+
+
+# ---- cluster façade: shared batcher, metadata cache, profiler ----
+
+
+def test_cluster_shared_reconstruct_batcher(tmp_path):
+    """Concurrent degraded reads share the cluster's per-loop batcher
+    (mirroring _encode_batcher) instead of one batcher per stream."""
+    payload = synthetic_bytes(3 * CHUNK_SIZE, seed=11)
+
+    async def main():
+        cluster = make_cluster(tmp_path)
+        profile = cluster.get_profile(None)
+        for name in ("a", "b"):
+            await cluster.write_file(name, aio.BytesReader(payload),
+                                     profile)
+            ref = await cluster.get_file_ref(name)
+            os.remove(ref.parts[0].data[0].locations[0].target)
+        loop = asyncio.get_running_loop()
+        got = await asyncio.gather(read_all(cluster, "a"),
+                                   read_all(cluster, "b"))
+        assert got == [payload, payload]
+        batcher = cluster._reconstruct_batchers.get(loop)
+        assert batcher is not None and batcher.groups > 0
+        # the same instance serves later reads on this loop
+        await read_all(cluster, "a")
+        assert cluster._reconstruct_batchers.get(loop) is batcher
+        await cluster.tunables.location_context().aclose()
+
+    asyncio.run(main())
+
+
+def test_file_ref_metadata_cache_write_invalidation(tmp_path):
+    """With the cache on, hot-object metadata parses once; a write-path
+    invalidation makes the next GET see the new object immediately."""
+    payload = synthetic_bytes(2000, seed=3)
+
+    async def main():
+        cluster = make_cluster(tmp_path, cache_bytes=1 << 20)
+        profile = cluster.get_profile(None)
+        await cluster.write_file("obj", aio.BytesReader(payload), profile)
+        ref1 = await cluster.get_file_ref("obj")
+        assert await cluster.get_file_ref("obj") is ref1  # cached parse
+        new_payload = synthetic_bytes(3000, seed=4)
+        await cluster.write_file("obj", aio.BytesReader(new_payload),
+                                 profile)
+        ref2 = await cluster.get_file_ref("obj")
+        assert ref2 is not ref1
+        assert await read_all(cluster, "obj") == new_payload
+
+        # a get_file_ref in flight across the write must not re-install
+        # the stale parse afterwards
+        cluster._file_refs.clear()
+        real_read = cluster.metadata.read
+        release = asyncio.Event()
+
+        async def slow_read(path):
+            obj = await real_read(path)
+            await release.wait()
+            return obj
+
+        cluster.metadata.read = slow_read
+        try:
+            stale = asyncio.ensure_future(cluster.get_file_ref("obj"))
+            await asyncio.sleep(0.01)
+            cluster.metadata.read = real_read
+            await cluster.write_file_ref("obj", ref2)
+            release.set()
+            await stale
+        finally:
+            cluster.metadata.read = real_read
+        assert "obj" not in cluster._file_refs
+
+        # cache off: every call re-parses
+        off = make_cluster(tmp_path)
+        a = await off.get_file_ref("obj")
+        b = await off.get_file_ref("obj")
+        assert a is not b
+        for c in (cluster, off):
+            await c.tunables.location_context().aclose()
+
+    asyncio.run(main())
+
+
+def test_profiler_surfaces_cache_counters(tmp_path):
+    """A fully hot read logs no I/O at all — the report carries the
+    cache's own counters instead."""
+    payload = synthetic_bytes(2 * CHUNK_SIZE, seed=9)
+
+    async def main():
+        cluster = make_cluster(tmp_path, cache_bytes=64 << 20)
+        profile = cluster.get_profile(None)
+        await cluster.write_file("obj", aio.BytesReader(payload), profile)
+        await read_all(cluster, "obj")  # fill
+        ref = await cluster.get_file_ref("obj")
+        profiler, reporter = new_profiler()
+        cx = cluster.tunables.location_context().but_with(
+            profiler=profiler)
+        builder = cluster.file_read_builder(ref).location_context(cx)
+        assert await builder.read_all() == payload
+        report = reporter.profile()
+        assert report.cache_stats, "cache counters missing from report"
+        stats = report.cache_stats[0]
+        assert stats.hits > 0
+        assert "Cache<" in str(report)
+        await cluster.tunables.location_context().aclose()
+
+    asyncio.run(main())
+
+
+def test_tunables_cache_bytes_serde(monkeypatch):
+    assert Tunables.from_obj(None).cache_bytes == 0
+    assert Tunables.from_obj({}).cache_bytes == 0
+    t = Tunables.from_obj({"cache_bytes": 1 << 20})
+    assert t.cache_bytes == 1 << 20
+    assert t.to_obj()["cache_bytes"] == 1 << 20
+    assert "cache_bytes" not in Tunables.from_obj({}).to_obj()
+    for bad in (-1, "lots", [1]):
+        with pytest.raises(SerdeError):
+            Tunables.from_obj({"cache_bytes": bad})
+    # env default: enables without YAML, YAML wins, garbage reads as off
+    monkeypatch.setenv(CACHE_BYTES_ENV, str(1 << 16))
+    assert Tunables.from_obj({}).cache_bytes == 1 << 16
+    assert Tunables.from_obj({"cache_bytes": 0}).cache_bytes == 0
+    monkeypatch.setenv(CACHE_BYTES_ENV, "banana")
+    assert Tunables.from_obj({}).cache_bytes == 0
+
+
+def test_gateway_range_gets_through_cache(tmp_path):
+    """Ranged GETs are served through the cache: whole chunks cached,
+    trimmed at the edge, bytes identical, repeats hit."""
+    payload = synthetic_bytes(3 * CHUNK_SIZE + 7777, seed=21)
+
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from chunky_bits_tpu.gateway import make_app
+
+        cluster = make_cluster(tmp_path, cache_bytes=64 << 20)
+        app = make_app(cluster)
+        async with TestClient(TestServer(app)) as client:
+            assert (await client.put("/obj", data=payload)).status == 200
+            ref = await cluster.get_file_ref("obj")
+            chunksizes = {part.chunksize for part in ref.parts}
+            # interleaved ranged + full GETs, twice each so the second
+            # pass is served from the cache
+            for _ in range(2):
+                resp = await client.get(
+                    "/obj", headers={"Range": "bytes=100-4099"})
+                assert resp.status == 206
+                assert await resp.read() == payload[100:4100]
+                lo = 2 * CHUNK_SIZE - 100
+                resp = await client.get(
+                    "/obj", headers={"Range": f"bytes={lo}-"})
+                assert resp.status == 206
+                assert await resp.read() == payload[lo:]
+                resp = await client.get("/obj")
+                assert await resp.read() == payload
+            cache = cluster._chunk_caches[asyncio.get_running_loop()]
+            assert cache.hits > 0
+            # every cached buffer is a whole chunk, never a trimmed range
+            assert {len(b) for b in cache._entries.values()} <= chunksizes
+        await cluster.tunables.location_context().aclose()
+
+    asyncio.run(main())

@@ -58,12 +58,22 @@ def synthetic_reader(n: int, seed: int = 0) -> aio.BytesReader:
 
 def test_cluster_from_yaml_examples(tmp_path):
     """All reference example shapes must parse (CI validate-example-clusters
-    analogue)."""
-    for name in ("local", "weights", "zones", "git", "test"):
-        with open(f"/root/reference/examples/{name}.yaml") as f:
-            obj = yaml.safe_load(f)
-        cluster = Cluster.from_obj(obj)
-        assert cluster.get_profile() is not None
+    analogue).  The repo's examples/ mirror the reference's five shapes
+    byte-compatibly (plus tpu.yaml), so the suite stays self-contained on
+    machines without the read-only reference checkout; when the checkout
+    IS present, its originals are validated too."""
+    repo_examples = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples")
+    roots = [repo_examples]
+    if os.path.isdir("/root/reference/examples"):
+        roots.append("/root/reference/examples")
+    for root in roots:
+        for name in ("local", "weights", "zones", "git", "test"):
+            with open(os.path.join(root, f"{name}.yaml")) as f:
+                obj = yaml.safe_load(f)
+            cluster = Cluster.from_obj(obj)
+            assert cluster.get_profile() is not None, (root, name)
 
 
 def test_zone_map_flattening():
