@@ -1,0 +1,39 @@
+"""Project-native invariant linter.
+
+The invariants that keep this system correct on a flaky-tunnel TPU box
+live in CLAUDE.md prose; this package makes them machine-checked.  Pure
+stdlib ``ast``/``tokenize`` — importing it never pulls jax, numpy, or
+aiohttp, so the gate runs even when the device tunnel is down and in
+bare CI runners.
+
+Invariant -> rule (suppression slug in backticks — the exact token the
+``# lint: <slug>-ok <reason>`` marker takes; each rule's docstring
+carries the full story):
+
+- degrade-never-hang (bounded device/network waits) -> CB101
+  ``unbounded-await``
+- env flags baked into jit caches at first dispatch   -> CB102
+  ``env-read``
+- 1-core box, workers parked in PJRT block exit       -> CB103
+  ``thread``
+- degraded-mode fallbacks must not eat corruption     -> CB104
+  ``broad-except``
+- this XLA CPU backend's jit-body pathologies         -> CB105
+  ``jit-hygiene``
+- strict typing on the public compute/serve surfaces  -> CB106
+  ``annotations``
+
+Entry points: ``python -m chunky_bits_tpu.analysis`` and
+``scripts/check.sh`` (tier-1 and CI both run the latter).  Violations
+are suppressed inline with ``# lint: <slug>-ok <reason>`` (the reason is
+mandatory) or recorded in ``analysis/baseline.toml`` so pre-existing
+findings stay green while NEW violations fail the gate.
+"""
+
+from chunky_bits_tpu.analysis.core import (  # noqa: F401
+    Violation,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+from chunky_bits_tpu.analysis.rules import ALL_RULES  # noqa: F401

@@ -1,0 +1,139 @@
+"""CLI: ``python -m chunky_bits_tpu.analysis``.
+
+Exit codes: 0 clean (no violations beyond the baseline), 1 new
+violations (or unparseable files — the gate must not go green because
+the tree stopped parsing), 2 usage errors.  ``--json`` emits one
+machine-readable object (mirrors bench.py's one-line contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from chunky_bits_tpu.analysis.core import (
+    iter_python_files,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+from chunky_bits_tpu.analysis.rules import ALL_RULES
+
+PACKAGE_ROOT = Path(__file__).resolve().parents[1]
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.toml"
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m chunky_bits_tpu.analysis",
+        description="project-native invariant linter (see analysis/"
+                    "__init__.py for the invariant -> rule map)")
+    parser.add_argument(
+        "paths", nargs="*", type=Path,
+        help="files/dirs to scan (default: the chunky_bits_tpu package)")
+    parser.add_argument(
+        "--root", type=Path, default=PACKAGE_ROOT,
+        help="root that rel paths (rule scopes, baseline entries) are "
+             "resolved against (default: the package dir)")
+    parser.add_argument(
+        "--baseline", type=Path, default=DEFAULT_BASELINE,
+        help="baseline file of accepted findings "
+             "(default: analysis/baseline.toml)")
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report every finding")
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept all current findings into the baseline and exit 0")
+    parser.add_argument(
+        "--select", default="",
+        help="comma-separated rule ids to run (e.g. CB101,CB104)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit one JSON object instead of text")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    rules = ALL_RULES
+    if args.select:
+        wanted = {r.strip().upper() for r in args.select.split(",")}
+        unknown = wanted - {r.id for r in ALL_RULES}
+        if unknown:
+            parser.error(f"unknown rule ids: {', '.join(sorted(unknown))}")
+        rules = tuple(r for r in ALL_RULES if r.id in wanted)
+
+    if args.list_rules:
+        for rule in rules:
+            print(f"{rule.id}  {rule.slug:16s} {rule.description}")
+        return 0
+
+    files = None
+    if args.paths:
+        files = []
+        for p in args.paths:
+            if p.is_dir():
+                files.extend(iter_python_files(p))
+            elif p.exists():
+                files.append(p)
+            else:
+                parser.error(f"no such path: {p}")
+
+    violations, errors = run_analysis(args.root, rules, files=files)
+
+    if args.write_baseline:
+        if args.select or files is not None:
+            # a restricted scan sees only a subset of findings; writing
+            # it out would silently drop every accepted entry outside
+            # the subset and fail the next full gate run for everyone
+            parser.error("--write-baseline requires a full scan "
+                         "(drop --select and explicit paths)")
+        if errors:
+            # same hazard as above: an unparseable file's accepted
+            # findings are absent from this scan, so writing now would
+            # drop them and re-fail the gate once the file is fixed
+            for err in errors:
+                print(f"ERROR {err}", file=sys.stderr)
+            parser.error("--write-baseline refused: the scan had file "
+                         "errors (fix them first)")
+        write_baseline(args.baseline, violations)
+        print(f"wrote {len(violations)} accepted finding(s) to "
+              f"{args.baseline}")
+        return 0
+
+    try:
+        baseline = set() if args.no_baseline \
+            else load_baseline(args.baseline)
+    except ValueError as err:
+        parser.error(str(err))
+    new = [v for v in violations if v.key() not in baseline]
+    matched = {v.key() for v in violations} & baseline
+    stale = len(baseline) - len(matched)
+
+    if args.json:
+        print(json.dumps({
+            "new": [v.__dict__ for v in new],
+            "baselined": len(matched),
+            "stale_baseline_entries": stale,
+            "errors": errors,
+            "ok": not new and not errors,
+        }))
+        return 1 if (new or errors) else 0
+
+    for err in errors:
+        print(f"ERROR {err}")
+    for v in new:
+        print(v.render())
+        print(f"    {v.snippet}")
+    summary = (f"{len(new)} new violation(s), {len(matched)} baselined, "
+               f"{stale} stale baseline entr(y/ies), "
+               f"{len(errors)} file error(s)")
+    if new or errors:
+        print(f"FAIL: {summary}")
+        return 1
+    print(f"ok: {summary}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,386 @@
+"""The project-native lint rules.
+
+Each rule pins one CLAUDE.md invariant to AST shape.  They are
+heuristics with an escape hatch by design: an inline
+``# lint: <slug>-ok <reason>`` records WHY a flagged site is safe, so
+the justification lives next to the code it excuses and shows up in
+review when either changes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+#: path prefixes of the device/network call paths — the routes where an
+#: unbounded wait or a non-daemon worker can hang a serve or block exit
+DEVICE_NET_PATHS = ("ops/", "parallel/", "gateway/", "file/chunk_cache.py")
+
+ENV_PREFIX = "CHUNKY_BITS_TPU_"
+
+#: the one module allowed to read CHUNKY_BITS_TPU_* from the process
+#: environment; everything else goes through its accessors
+ENV_HOME = "cluster/tunables.py"
+
+#: the strict-typing public surfaces (mirrors the [tool.mypy] overrides
+#: in pyproject.toml, which enforce the same set when mypy is installed)
+STRICT_TYPED_MODULES = (
+    "ops/backend.py",
+    "file/chunk_cache.py",
+    "cluster/tunables.py",
+    "file/file_part.py",
+    "parallel/backend.py",
+)
+
+Finding = tuple[int, int, str]
+
+
+class Rule:
+    id: str = ""
+    slug: str = ""
+    description: str = ""
+    #: rel-path prefixes the rule applies to; () = every file
+    paths: tuple[str, ...] = ()
+
+    def applies(self, rel: str) -> bool:
+        if not self.paths:
+            return True
+        return any(rel == p or rel.startswith(p) for p in self.paths)
+
+    def check(self, sf) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted name for Name/Attribute chains ('os.environ.get'), or ''."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _parents(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    par: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            par[child] = node
+    return par
+
+
+class UnboundedAwaitRule(Rule):
+    """CB101 — degrade, never hang (CLAUDE.md).
+
+    On device/network call paths every wait must be bounded: PJRT park
+    or a dead peer otherwise hangs the serve forever.  Flags ``await``
+    on bare futures/tasks and on the known-unbounded wait primitives
+    (``.wait()``, ``.wait_closed()``, ``.join()``, ``.serve_forever()``,
+    ``run_in_executor``).  Bounded alternatives: ``asyncio.wait_for``,
+    the dispatch-timeout wrappers (ops/jax_backend.run_bounded_dispatch),
+    or a liveness argument recorded via
+    ``# lint: unbounded-await-ok <reason>``.
+    """
+
+    id = "CB101"
+    slug = "unbounded-await"
+    description = ("await on device/network paths must be reachable "
+                   "through a timeout guard")
+    paths = DEVICE_NET_PATHS
+
+    WATCH = ("wait", "wait_closed", "join", "serve_forever",
+             "run_in_executor")
+
+    def check(self, sf) -> Iterator[Finding]:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Await):
+                continue
+            value = node.value
+            if isinstance(value, (ast.Name, ast.Attribute)):
+                yield (node.lineno, node.col_offset,
+                       "await on a bare future/task is unbounded; wrap "
+                       "in asyncio.wait_for or justify with "
+                       "`# lint: unbounded-await-ok <reason>`")
+            elif (isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Attribute)
+                    and value.func.attr in self.WATCH):
+                yield (node.lineno, node.col_offset,
+                       f"await .{value.func.attr}() has no deadline; "
+                       "wrap in asyncio.wait_for or justify with "
+                       "`# lint: unbounded-await-ok <reason>`")
+
+
+class EnvFlagDisciplineRule(Rule):
+    """CB102 — flags are read at first dispatch and baked into jit
+    caches (CLAUDE.md), so scattered ad-hoc reads make 'where is this
+    knob read, and when' unanswerable.  All ``CHUNKY_BITS_TPU_*``
+    environment reads go through cluster/tunables.py accessors
+    (``env_flag`` / ``env_seconds`` / ``env_str``); a deliberate
+    first-dispatch read elsewhere carries
+    ``# lint: env-read-ok <reason>``.  Writes (the CLI's backend
+    handoff) are out of scope — the hazard is read placement.
+    """
+
+    id = "CB102"
+    slug = "env-read"
+    description = ("CHUNKY_BITS_TPU_* environment reads belong in "
+                   "cluster/tunables.py accessors")
+
+    def applies(self, rel: str) -> bool:
+        return rel != ENV_HOME and not rel.startswith("analysis/")
+
+    def _key_of(self, sf, node: ast.AST) -> str:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            return sf.constants.get(node.id, "")
+        return ""
+
+    def check(self, sf) -> Iterator[Finding]:
+        for node in ast.walk(sf.tree):
+            key = ""
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if chain in ("os.environ.get", "environ.get",
+                             "os.environ.setdefault",
+                             "environ.setdefault",
+                             "os.getenv", "getenv") and node.args:
+                    key = self._key_of(sf, node.args[0])
+            elif (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Load)
+                    and _attr_chain(node.value) in ("os.environ",
+                                                    "environ")):
+                key = self._key_of(sf, node.slice)
+            if key.startswith(ENV_PREFIX):
+                yield (node.lineno, node.col_offset,
+                       f"direct read of ${key}: route through "
+                       "cluster/tunables.py accessors (env_flag/"
+                       "env_seconds/env_str) or justify a designated "
+                       "first-dispatch site with "
+                       "`# lint: env-read-ok <reason>`")
+
+
+class NonDaemonThreadRule(Rule):
+    """CB103 — 1-core box: ThreadPoolExecutor workers are non-daemon
+    and join at interpreter exit, so one worker parked inside PJRT
+    blocks exit forever (CLAUDE.md).  Device-wait paths use plain
+    ``threading.Thread(daemon=True)``; a pool that provably never
+    touches the device records that with ``# lint: thread-ok <reason>``.
+    """
+
+    id = "CB103"
+    slug = "thread"
+    description = ("no ThreadPoolExecutor / non-daemon Thread on "
+                   "device-wait paths")
+    paths = ("ops/", "parallel/")
+
+    def check(self, sf) -> Iterator[Finding]:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            tail = chain.rsplit(".", 1)[-1]
+            if tail == "ThreadPoolExecutor":
+                yield (node.lineno, node.col_offset,
+                       "ThreadPoolExecutor on a device-wait path: its "
+                       "non-daemon workers block interpreter exit when "
+                       "parked in PJRT — use threading.Thread("
+                       "daemon=True) or justify with "
+                       "`# lint: thread-ok <reason>`")
+            elif tail == "Thread" and chain in ("Thread",
+                                                "threading.Thread"):
+                daemon_true = any(
+                    kw.arg == "daemon"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                    for kw in node.keywords)
+                if not daemon_true:
+                    yield (node.lineno, node.col_offset,
+                           "non-daemon Thread on a device-wait path "
+                           "blocks interpreter exit when parked in "
+                           "PJRT; pass daemon=True or justify with "
+                           "`# lint: thread-ok <reason>`")
+
+
+class BroadExceptRule(Rule):
+    """CB104 — degraded-mode fallbacks must not silently eat corruption
+    signals.  ``except Exception`` (or broader) is allowed only when it
+    (a) ends in a ``raise`` (nothing can be swallowed), or (b) carries a
+    ``# lint: broad-except-ok <reason>`` justification — so every
+    swallow-and-continue site states what it degrades to and why that
+    cannot hide corruption.  ``# noqa: BLE001 <reason>`` is accepted as
+    the same marker.
+    """
+
+    id = "CB104"
+    slug = "broad-except"
+    description = ("broad except handlers must re-raise or carry a "
+                   "justification")
+
+    BROAD = ("Exception", "BaseException")
+
+    def _is_broad(self, type_node) -> bool:
+        if type_node is None:
+            return True  # bare except:
+        if isinstance(type_node, ast.Name):
+            return type_node.id in self.BROAD
+        if isinstance(type_node, ast.Tuple):
+            return any(self._is_broad(el) for el in type_node.elts)
+        return False
+
+    def check(self, sf) -> Iterator[Finding]:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node.type):
+                continue
+            if node.body and isinstance(node.body[-1], ast.Raise):
+                continue  # terminal re-raise: cannot swallow
+            shown = "bare except" if node.type is None else \
+                f"except {ast.unparse(node.type)}"
+            yield (node.lineno, node.col_offset,
+                   f"{shown} without a terminal raise can swallow "
+                   "corruption signals; narrow the type or justify "
+                   "with `# lint: broad-except-ok <reason>`")
+
+
+class JitBodyHygieneRule(Rule):
+    """CB105 — this jax build's XLA CPU backend mishandles two jit-body
+    shapes (CLAUDE.md, ops/sha256_jax.py docstrings): unrolled ~2000-op
+    integer bodies blow up compile superlinearly (use ``fori_loop``),
+    and odd-width u8 device concats can spin forever at runtime (keep
+    device buffers 64-aligned).  Flags large-literal ``range`` loops
+    inside traced functions, and ``jnp.concatenate``/``stack`` calls —
+    the latter must record their alignment argument via
+    ``# lint: jit-hygiene-ok <why aligned>`` or live in the baseline.
+    """
+
+    id = "CB105"
+    slug = "jit-hygiene"
+    description = ("no unrolled loop bodies or unjustified device "
+                   "concats in ops/ jit code")
+    paths = ("ops/",)
+
+    UNROLL_THRESHOLD = 64
+    CONCAT = ("concatenate", "stack", "hstack", "vstack")
+    TRACE_NAMES = ("jnp", "lax", "pl", "plgpu", "pltpu")
+
+    def check(self, sf) -> Iterator[Finding]:
+        parents = _parents(sf.tree)
+
+        def nearest_def(node: ast.AST):
+            cur = parents.get(node)
+            while cur is not None and not isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cur = parents.get(cur)
+            return cur
+
+        traced_cache: dict[ast.AST, bool] = {}
+
+        def is_traced(fn) -> bool:
+            if fn is None:
+                return False
+            if fn not in traced_cache:
+                traced_cache[fn] = any(
+                    isinstance(n, ast.Name) and n.id in self.TRACE_NAMES
+                    for n in ast.walk(fn))
+            return traced_cache[fn]
+
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.For):
+                it = node.iter
+                if not (isinstance(it, ast.Call)
+                        and isinstance(it.func, ast.Name)
+                        and it.func.id == "range"):
+                    continue
+                bound = max((a.value for a in it.args
+                             if isinstance(a, ast.Constant)
+                             and isinstance(a.value, int)), default=0)
+                if bound >= self.UNROLL_THRESHOLD \
+                        and is_traced(nearest_def(node)):
+                    yield (node.lineno, node.col_offset,
+                           f"range({bound}) loop in a traced function "
+                           "unrolls into the jit body (superlinear "
+                           "compile blow-up on this XLA CPU backend); "
+                           "use jax.lax.fori_loop, or justify with "
+                           "`# lint: jit-hygiene-ok <reason>`")
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self.CONCAT
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "jnp"):
+                yield (node.lineno, node.col_offset,
+                       f"jnp.{node.func.attr} in ops/: odd-width u8 "
+                       "device concats can spin forever on this XLA "
+                       "CPU backend — state the lane-alignment "
+                       "argument with `# lint: jit-hygiene-ok <why "
+                       "aligned>` (see ops/sha256_jax.py docstrings)")
+
+
+class PublicAnnotationsRule(Rule):
+    """CB106 — the runnable half of the strict typing gate: the public
+    surfaces listed in ``STRICT_TYPED_MODULES`` must carry full
+    parameter and return annotations.  mypy (when installed — see
+    scripts/check.sh) enforces consistency; this rule enforces presence
+    even on boxes without mypy, so the tier-1 gate never silently loses
+    the typing floor.
+    """
+
+    id = "CB106"
+    slug = "annotations"
+    description = ("public functions on strict-typed modules need full "
+                   "annotations")
+    paths = STRICT_TYPED_MODULES
+
+    def applies(self, rel: str) -> bool:
+        return rel in self.paths
+
+    def check(self, sf) -> Iterator[Finding]:
+        def check_fn(fn, is_method: bool) -> Iterator[Finding]:
+            if fn.name.startswith("_"):
+                return
+            args = fn.args
+            named = (list(args.posonlyargs) + list(args.args)
+                     + list(args.kwonlyargs))
+            if is_method and named and named[0].arg in ("self", "cls"):
+                named = named[1:]
+            missing = [a.arg for a in named if a.annotation is None]
+            for extra in (args.vararg, args.kwarg):
+                if extra is not None and extra.annotation is None:
+                    missing.append(f"*{extra.arg}")
+            if missing:
+                yield (fn.lineno, fn.col_offset,
+                       f"public {'method' if is_method else 'function'} "
+                       f"{fn.name}() missing parameter annotations: "
+                       f"{', '.join(missing)} (strict typing gate)")
+            if fn.returns is None:
+                yield (fn.lineno, fn.col_offset,
+                       f"public {'method' if is_method else 'function'} "
+                       f"{fn.name}() missing a return annotation "
+                       "(strict typing gate)")
+
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from check_fn(node, is_method=False)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        is_static = any(
+                            isinstance(d, ast.Name)
+                            and d.id == "staticmethod"
+                            for d in sub.decorator_list)
+                        yield from check_fn(sub,
+                                            is_method=not is_static)
+
+
+ALL_RULES: tuple[Rule, ...] = (
+    UnboundedAwaitRule(),
+    EnvFlagDisciplineRule(),
+    NonDaemonThreadRule(),
+    BroadExceptRule(),
+    JitBodyHygieneRule(),
+    PublicAnnotationsRule(),
+)

@@ -162,7 +162,12 @@ def _shard_geometry(args, targets: list) -> tuple[int, int]:
 
 async def run(args) -> int:
     if args.backend:
-        os.environ["CHUNKY_BITS_TPU_BACKEND"] = args.backend
+        # a WRITE, not a read: the CLI flag travels to ops/backend's
+        # first-dispatch resolution through the env handoff (read back
+        # via tunables.env_str — lint rule CB102 governs the read side)
+        from chunky_bits_tpu.cluster.tunables import BACKEND_ENV
+
+        os.environ[BACKEND_ENV] = args.backend
     config = await Config.load_or_default(
         args.config, chunk_size=args.chunk_size,
         data_chunks=args.data_chunks, parity_chunks=args.parity_chunks)
@@ -464,7 +469,9 @@ def main(argv=None) -> int:
         try:
             devnull = os.open(os.devnull, os.O_WRONLY)
             os.dup2(devnull, sys.stdout.fileno())
-        except Exception:
+        except (OSError, ValueError):
+            # fileno() raises ValueError/io.UnsupportedOperation on a
+            # replaced stdout; everything else here raises OSError
             pass
         return 141  # 128 + SIGPIPE
 

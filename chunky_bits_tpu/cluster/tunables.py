@@ -29,6 +29,59 @@ from chunky_bits_tpu.file.location import IGNORE, OVERWRITE, LocationContext
 
 CACHE_BYTES_ENV = "CHUNKY_BITS_TPU_CACHE_BYTES"
 
+#: the backend-selection handoff: the CLI --backend flag writes it, the
+#: default resolution in ops/backend.get_backend reads it
+BACKEND_ENV = "CHUNKY_BITS_TPU_BACKEND"
+
+
+# ---- environment accessors (the ONE home for CHUNKY_BITS_TPU_* reads) ----
+#
+# Every ``CHUNKY_BITS_TPU_*`` read in the tree goes through these three
+# accessors (lint rule CB102, chunky_bits_tpu/analysis).  Two contracts
+# they deliberately do NOT change:
+#
+# - **Read-at-first-dispatch.**  Callers invoke the accessor at the
+#   moment the knob takes effect (first backend resolution, first
+#   device dispatch, first mmap decision) — never at import time and
+#   never cached here.  Values feeding jit-compiled routing are baked
+#   into compiled executables by the caller's jit cache, so flipping a
+#   flag after the first encode of a process has no effect; set flags
+#   before the first dispatch (CLAUDE.md "Measure before defaulting").
+# - **One parse per knob shape.**  Truthiness (env_flag) and duration
+#   (env_seconds) parse identically for every flag, so operators learn
+#   one spelling; per-knob defaults stay at the call site where the
+#   behavior they gate is defined.
+
+_FALSY = ("", "0", "false", "no", "off")
+
+
+def env_str(name: str, default: str = "") -> str:
+    """Raw string value of an env knob; unset reads as ``default``."""
+    return os.environ.get(name, default)
+
+
+def env_flag(name: str, *, default: bool = False) -> bool:
+    """Standard boolean env-flag parsing: unset -> ``default``;
+    "", "0", "false", "no", "off" (any case/whitespace) -> False;
+    anything else -> True."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in _FALSY
+
+
+def env_seconds(name: str, *, default: float) -> float:
+    """Duration env knob in seconds; unset/empty -> ``default``.  A
+    malformed value raises ``ValueError`` — a config typo must fail the
+    caller loudly, not read as a device outage and silently degrade."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"bad ${name}={raw!r} (want seconds)") from None
+
 
 def _default_cache_bytes() -> int:
     """Env-supplied default; malformed or negative values read as off
@@ -65,7 +118,7 @@ class Tunables:
         )
 
     @classmethod
-    def from_obj(cls, obj) -> "Tunables":
+    def from_obj(cls, obj: object) -> "Tunables":
         if obj is None:
             return cls()
         if not isinstance(obj, dict):

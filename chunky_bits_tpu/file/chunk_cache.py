@@ -77,7 +77,7 @@ class ChunkCache:
     """Bounded byte-budget LRU of verified chunk buffers, digest-keyed,
     with singleflight fetch deduplication."""
 
-    def __init__(self, capacity_bytes: int):
+    def __init__(self, capacity_bytes: int) -> None:
         if capacity_bytes <= 0:
             raise ValueError("capacity_bytes must be positive")
         self.capacity = int(capacity_bytes)
@@ -127,6 +127,11 @@ class ChunkCache:
             if flight is None:
                 break
             self.coalesced += 1
+            # lint: unbounded-await-ok the winner sets the event in a
+            # finally even on error/cancel (and `died` hands the flight
+            # to a waiter), so this waits exactly as long as the
+            # winner's fetch — which is itself bounded by the location
+            # layer's network timeouts
             await flight.event.wait()
             if flight.died:
                 continue  # winner never produced an outcome: take over
@@ -149,7 +154,9 @@ class ChunkCache:
             flight.result = stored if stored is not None else data
         return data
 
-    async def insert_verified(self, hash_: AnyHash, data) -> bool:
+    async def insert_verified(self, hash_: AnyHash,
+                              data: bytes | bytearray | memoryview
+                              ) -> bool:
         """Verify-then-insert for buffers that did NOT come off a
         verified fetch (RS-reconstructed rows, pre-warming).  Re-hashes
         off-loop; a digest mismatch is rejected and counted — corrupted
@@ -161,7 +168,8 @@ class ChunkCache:
             return False
         return self._insert(hash_.value.digest, data) is not None
 
-    def _insert(self, digest: bytes, data) -> Optional[bytes]:
+    def _insert(self, digest: bytes, data: bytes | bytearray | memoryview
+                ) -> Optional[bytes]:
         """Store ``data`` (normalized to bytes — an mmap view must not
         pin its inode for the cache's lifetime), evicting LRU entries
         past the byte budget.  Returns the stored bytes, or None when

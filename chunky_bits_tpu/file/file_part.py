@@ -17,7 +17,7 @@ import asyncio
 import enum
 import random
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import TYPE_CHECKING, Any, Iterator, Optional
 
 import numpy as np
 
@@ -33,6 +33,17 @@ from chunky_bits_tpu.file.location import Location, LocationContext, \
     default_context
 from chunky_bits_tpu.ops import ErasureCoder, get_coder
 from chunky_bits_tpu.utils import aio
+
+if TYPE_CHECKING:  # typing-only: neither module is needed at import time
+    from chunky_bits_tpu.file.chunk_cache import ChunkCache
+    from chunky_bits_tpu.file.collection_destination import (
+        CollectionDestination,
+    )
+    from chunky_bits_tpu.ops.batching import ReconstructBatcher
+
+#: buffer-protocol payloads the codec surfaces accept (numpy rows are
+#: normalized to memoryview at the boundaries that take them)
+BufferLike = bytes | bytearray | memoryview
 
 
 class LocationIntegrity(enum.IntEnum):
@@ -74,7 +85,8 @@ class FileIntegrity(enum.IntEnum):
 _FUSED_HASHER = None  # resolved once: sha256_file or False
 
 
-async def _hash_local_fused(chunk, location, cx):
+async def _hash_local_fused(chunk: Chunk, location: Location,
+                            cx: LocationContext) -> Optional[bytes]:
     """Digest of a local chunk file via the native streaming read+hash
     pass (C++ SHA-NI; ops/cpu_backend.sha256_file), which never surfaces
     the bytes to Python.  Returns None when the fast path doesn't apply —
@@ -94,6 +106,8 @@ async def _hash_local_fused(chunk, location, cx):
 
             await asyncio.to_thread(sha256_buf, b"")  # force deferred build
             _FUSED_HASHER = sha256_file
+        # lint: broad-except-ok native build probe; the generic read
+        # path re-reads and re-hashes, so no verification is lost
         except Exception:
             _FUSED_HASHER = False
     if _FUSED_HASHER is False:
@@ -106,7 +120,8 @@ async def _hash_local_fused(chunk, location, cx):
         return None
 
 
-async def _read_chunk_payload(location, cx):
+async def _read_chunk_payload(location: Location, cx: LocationContext
+                              ) -> bytes | memoryview:
     """Chunk bytes for the read/resilver paths: a zero-copy page-cache
     view for local chunks (``Location.read_view`` — hash verification,
     RS reconstruction, and shard re-writes all consume buffers), else
@@ -117,9 +132,10 @@ async def _read_chunk_payload(location, cx):
     return await location.read(cx)
 
 
-async def _reconstruct(arrays, d: int, p: int,
+async def _reconstruct(arrays: list[Optional[np.ndarray]], d: int, p: int,
                        coder: Optional[ErasureCoder], backend: Optional[str],
-                       batcher, data_only: bool):
+                       batcher: Optional[ReconstructBatcher],
+                       data_only: bool) -> list[Optional[np.ndarray]]:
     """Fill the ``None`` rows of ``arrays``: through the shared batcher
     when one is wired in (coalesced device dispatches), else via a lazily
     resolved coder off-loop — constructing a device backend (jax init) can
@@ -133,7 +149,8 @@ async def _reconstruct(arrays, d: int, p: int,
     return await asyncio.to_thread(fn, arrays)
 
 
-def split_into_shards(data_buf, length: int, d: int):
+def split_into_shards(data_buf: BufferLike, length: int, d: int
+                      ) -> tuple[list[memoryview], int]:
     """Split ``length`` meaningful bytes (backed by a zero-padded buffer)
     into d equal shards of ceil(length/d) bytes — the reference's round-up
     split (src/file/file_part.rs:150-158).  Returns (shards, shard_len)."""
@@ -186,7 +203,8 @@ class FilePart:
     async def read(self, cx: Optional[LocationContext] = None,
                    coder: Optional[ErasureCoder] = None,
                    backend: Optional[str] = None,
-                   batcher=None, cache=None) -> bytes:
+                   batcher: Optional[ReconstructBatcher] = None,
+                   cache: Optional[ChunkCache] = None) -> bytes:
         """``read_buffers`` joined into one bytes object (padding
         included; the file reader trims)."""
         return b"".join(
@@ -195,7 +213,8 @@ class FilePart:
     async def read_buffers(self, cx: Optional[LocationContext] = None,
                            coder: Optional[ErasureCoder] = None,
                            backend: Optional[str] = None,
-                           batcher=None, cache=None) -> list:
+                           batcher: Optional[ReconstructBatcher] = None,
+                           cache: Optional[ChunkCache] = None) -> list:
         """Scattered read: d workers randomly grab chunks from the shared
         d+p pool, falling through each chunk's locations; RS-reconstruct if
         any data chunk is missing.  Returns the d data-chunk buffers in
@@ -220,7 +239,9 @@ class FilePart:
             # profiler surfaces the cache's own counters instead
             cx.profiler.attach_cache(cache)
         d, p = len(self.data), len(self.parity)
-        slots: list[Optional[bytes]] = [None] * (d + p)
+        # slot payloads are bytes OR zero-copy memoryviews OR rebuilt
+        # array views — deliberately untyped (the consumers take buffers)
+        slots: list = [None] * (d + p)
         pool: list[tuple[int, Chunk]] = []
         for index, chunk in enumerate(self.all_chunks()):
             buf = (cache.get(chunk.cache_key())
@@ -232,7 +253,7 @@ class FilePart:
                 pool.append((index, chunk))
         pool_lock = asyncio.Lock()
 
-        async def read_verified(chunk: Chunk, location
+        async def read_verified(chunk: Chunk, location: Location
                                 ) -> tuple[bool, object]:
             """(hash_ok, data) with local chunks served in ONE worker
             -thread hop: the page-cache map and the hash verification
@@ -241,7 +262,7 @@ class FilePart:
             ~ms-scale hop latency — not the bytes — dominates."""
             mapper = location.read_view_mapper(cx)
             if mapper is not None:
-                def mapped_and_verified():
+                def mapped_and_verified() -> Optional[tuple[bool, object]]:
                     data = mapper()
                     if data is None:
                         return None  # unmappable: generic path below
@@ -257,7 +278,7 @@ class FilePart:
                 data = await _read_chunk_payload(location, cx)
             return (await chunk.hash.verify_async(data), data)
 
-        async def fetch_chunk(chunk: Chunk):
+        async def fetch_chunk(chunk: Chunk) -> Optional[object]:
             """First verified buffer across the chunk's locations, or
             None when every location is unreadable/corrupt."""
             for location in chunk.locations:
@@ -269,7 +290,7 @@ class FilePart:
                     return data
             return None
 
-        async def worker() -> Optional[tuple[int, bytes]]:
+        async def worker() -> Optional[tuple[int, object]]:
             while True:
                 async with pool_lock:
                     if not pool:
@@ -323,7 +344,8 @@ class FilePart:
     # ---- encode (pure compute half; no I/O) ----
 
     @staticmethod
-    def encode_shards(coder: ErasureCoder, data_buf, length: int
+    def encode_shards(coder: ErasureCoder, data_buf: BufferLike,
+                      length: int
                       ) -> tuple[list[memoryview], list[np.ndarray], int]:
         """Split + parity computation (src/file/file_part.rs:150-165).
         Pure so batching layers can aggregate parts into one dispatch."""
@@ -342,8 +364,8 @@ class FilePart:
     @staticmethod
     async def write_with_coder(
         coder: ErasureCoder,
-        destination,
-        data_buf,
+        destination: CollectionDestination,
+        data_buf: BufferLike,
         length: int,
         precomputed: Optional[tuple] = None,
     ) -> "FilePart":
@@ -370,7 +392,8 @@ class FilePart:
                 f"for {d}+{p} shards")
         writers = destination.get_writers(d + p)
 
-        async def hash_and_write(payload, writer, digest) -> Chunk:
+        async def hash_and_write(payload: Any, writer: Any,
+                                 digest: Optional[bytes]) -> Chunk:
             # Zero-copy normalization: numpy rows and memoryviews flow
             # through to the writers as buffers; only exotic payloads pay
             # a bytes() copy.
@@ -413,7 +436,8 @@ class FilePart:
         cx = cx or default_context()
         sem = asyncio.Semaphore(self.VERIFY_READ_CONCURRENCY)
 
-        async def check(ci: int, chunk: Chunk, li: int, location: Location):
+        async def check(ci: int, chunk: Chunk, li: int,
+                        location: Location) -> tuple:
             async with sem:
                 digest = await _hash_local_fused(chunk, location, cx)
                 if digest is not None:
@@ -436,24 +460,26 @@ class FilePart:
 
     # ---- resilver (src/file/file_part.rs:253-389) ----
 
-    async def resilver(self, destination,
+    async def resilver(self, destination: CollectionDestination,
                        cx: Optional[LocationContext] = None,
                        coder: Optional[ErasureCoder] = None,
                        backend: Optional[str] = None,
-                       batcher=None) -> "ResilverPartReport":
+                       batcher: Optional[ReconstructBatcher] = None
+                       ) -> "ResilverPartReport":
         # Deviation from the reference: repair writes always overwrite.
         # Under the default `on_conflict: ignore` tunable the reference's
         # resilver silently keeps a corrupt chunk file when the rebuilt
         # shard lands on the node already holding it (write_subfile sees the
         # file exists and skips); overwriting a content-addressed chunk with
         # bytes matching its hash is always safe.
-        if hasattr(destination, "with_conflict_overwrite"):
-            destination = destination.with_conflict_overwrite()
+        overwrite = getattr(destination, "with_conflict_overwrite", None)
+        if overwrite is not None:
+            destination = overwrite()
         cx = cx or destination.get_context()
         chunks = self.all_chunks()
         d, p = len(self.data), len(self.parity)
 
-        async def read_chunk(chunk: Chunk):
+        async def read_chunk(chunk: Chunk) -> tuple:
             report = []
             chunk_bytes = None
             for location in chunk.locations:
@@ -492,6 +518,9 @@ class FilePart:
                     a.tobytes() if isinstance(a, np.ndarray) else None
                     for a in arrays
                 ]
+            # lint: broad-except-ok surfaced as the report's
+            # write_error (resilver reports failures, it never crashes
+            # a sweep mid-file)
             except Exception as err:
                 write_error = str(err)
                 rebuilt = data_bufs
@@ -507,6 +536,8 @@ class FilePart:
                         request.append(None)
                 try:
                     writers = destination.get_used_writers(request)
+                # lint: broad-except-ok surfaced as the report's
+                # write_error; read results above still stand
                 except Exception as err:
                     write_error = str(err)
                     writers = []
@@ -560,7 +591,7 @@ class _PartReportBase:
         return best
 
     @staticmethod
-    def _to_integrity(res) -> LocationIntegrity:
+    def _to_integrity(res: Optional[tuple]) -> LocationIntegrity:
         if res is None:
             return LocationIntegrity.VALID  # location never read (resilver)
         ok, _err = res
@@ -592,7 +623,8 @@ class _PartReportBase:
                 for (ci, li), (ok, _e) in self.read_results.items()
                 if ok is False]
 
-    def locations_with_integrity(self):
+    def locations_with_integrity(
+            self) -> Iterator[tuple[Location, LocationIntegrity]]:
         chunks = self.file_part.all_chunks()
         for (ci, li), res in sorted(self.read_results.items()):
             yield chunks[ci].locations[li], self._to_integrity(res)
@@ -607,7 +639,7 @@ class _PartReportBase:
 class VerifyPartReport(_PartReportBase):
     """(src/file/file_part.rs:570-647)"""
 
-    def __init__(self, file_part: FilePart, read_results: dict):
+    def __init__(self, file_part: FilePart, read_results: dict) -> None:
         self.file_part = file_part
         self.read_results = read_results
 
@@ -644,7 +676,7 @@ class ResilverPartReport(_PartReportBase):
     """(src/file/file_part.rs:671-838)"""
 
     def __init__(self, file_part: FilePart, write_error: Optional[str],
-                 write_results: dict, read_results: dict):
+                 write_results: dict, read_results: dict) -> None:
         self.file_part = file_part
         self.write_error = write_error
         self.write_results = write_results

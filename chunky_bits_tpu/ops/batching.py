@@ -65,6 +65,9 @@ class _CoalescingBatcher:
         self._pending.append((key, payload, fut))
         if self._task is None or self._task.done():
             self._task = asyncio.create_task(self._drain())
+        # lint: unbounded-await-ok resolved (result or exception) by
+        # _dispatch in every outcome, and the device work underneath is
+        # bounded by run_bounded_dispatch's deadline
         return await fut
 
     async def _drain(self) -> None:
@@ -91,6 +94,8 @@ class _CoalescingBatcher:
         try:
             results = await asyncio.to_thread(
                 self._run_group, key, [g[1] for g in group])
+        # lint: broad-except-ok delivered to every waiter via
+        # fut.set_exception; CancelledError additionally re-raised
         except BaseException as err:
             for _, _, fut in group:
                 if not fut.done():
@@ -246,6 +251,8 @@ class EncodeHashBatcher(_CoalescingBatcher):
                 self.dispatches += 1
                 try:
                     out.append(self._encode(coder, b))
+                # lint: broad-except-ok re-raised at the owning waiter
+                # through _GroupItemError; other batches must proceed
                 except Exception as err:
                     out.append(_GroupItemError(err))
             return out

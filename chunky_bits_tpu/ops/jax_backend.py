@@ -94,17 +94,14 @@ def await_device_init() -> None:
     global _device_ready, _device_failed
     if _device_ready:
         return
-    import os
-
+    from chunky_bits_tpu.cluster.tunables import env_seconds
     from chunky_bits_tpu.errors import DeviceInitTimeout, ErasureError
 
     probe = _DEVICE_PROBE or (lambda: _ensure_jax()[0].devices())
-    raw = os.environ.get(DEVICE_INIT_TIMEOUT_ENV, "120")
     try:
-        timeout = float(raw)
-    except ValueError:
-        raise ErasureError(
-            f"bad ${DEVICE_INIT_TIMEOUT_ENV}={raw!r} (want seconds)")
+        timeout = env_seconds(DEVICE_INIT_TIMEOUT_ENV, default=120.0)
+    except ValueError as err:
+        raise ErasureError(str(err)) from None
     with _DEVICE_READY_LOCK:
         if _device_ready:
             return
@@ -124,6 +121,8 @@ def await_device_init() -> None:
         def _run() -> None:
             try:
                 probe()
+            # lint: broad-except-ok relayed to the waiting caller via
+            # box and re-raised there
             except BaseException as err:
                 box["err"] = err
             finally:
@@ -157,16 +156,14 @@ def run_bounded_dispatch(fn, what: str):
     ``await_device_init``: callers go CPU-only afterwards, so the stuck
     thread is inert.  With the env knob at 0 the call runs inline
     (zero overhead, pre-round-5 behavior)."""
-    import os
-
+    from chunky_bits_tpu.cluster.tunables import env_seconds
     from chunky_bits_tpu.errors import DeviceDispatchTimeout, ErasureError
 
-    raw = os.environ.get(DISPATCH_TIMEOUT_ENV, "")
     try:
-        timeout = float(raw) if raw else _DISPATCH_TIMEOUT_DEFAULT
-    except ValueError:
-        raise ErasureError(
-            f"bad ${DISPATCH_TIMEOUT_ENV}={raw!r} (want seconds)")
+        timeout = env_seconds(DISPATCH_TIMEOUT_ENV,
+                              default=_DISPATCH_TIMEOUT_DEFAULT)
+    except ValueError as err:
+        raise ErasureError(str(err)) from None
     if timeout <= 0:
         return fn()
     done = threading.Event()
@@ -175,6 +172,8 @@ def run_bounded_dispatch(fn, what: str):
     def _run() -> None:
         try:
             box["out"] = fn()
+        # lint: broad-except-ok relayed to the waiting caller via box
+        # and re-raised there
         except BaseException as err:
             box["err"] = err
         finally:
@@ -334,6 +333,8 @@ class JaxBackend(ErasureBackend):
         if self._on_tpu and s % 128 == 0 and s >= 1024:
             try:
                 return self._apply_pallas_blocked(mat, shards, on_block)
+            # lint: broad-except-ok warned + recomputed via the einsum
+            # path below; no result from the failed kernel is kept
             except Exception as err:
                 # An unexpected Mosaic/compile failure would otherwise be
                 # re-attempted (and re-compiled, seconds each) on every
@@ -429,10 +430,13 @@ class JaxBackend(ErasureBackend):
         on-chip A/B (exp_devsha.py) shows it beating host SHA x cores.
         Read at dispatch time, but jit caches bake the routing into
         compiled executables, so set it before the first encode (same
-        caveat as the packed-kernel flag, PARITY.md)."""
-        import os
+        caveat as the packed-kernel flag, PARITY.md).  Exactly ``"1"``
+        enables — deliberately stricter than env_flag's truthiness,
+        matching the documented opt-in spelling for a path still
+        pending its on-chip A/B."""
+        from chunky_bits_tpu.cluster.tunables import env_str
 
-        return os.environ.get("CHUNKY_BITS_TPU_DEVICE_SHA") == "1"
+        return env_str("CHUNKY_BITS_TPU_DEVICE_SHA") == "1"
 
     def _fused_encode_hash_fn(self, mat: np.ndarray, s: int,
                               interpret: bool = False):
@@ -459,6 +463,8 @@ class JaxBackend(ErasureBackend):
         def fused(dev):
             b, k, _ = dev.shape
             parity = apply_matrix_pallas(mat, dev, interpret=interpret)
+            # lint: jit-hygiene-ok rows are s bytes with s % 128 == 0
+            # (the pallas-path gate), so the concat is lane-aligned
             digests = sha(jnp.concatenate(
                 [dev, parity], axis=1).reshape(b * (k + r), s))
             return parity, digests.reshape(b, k + r, 32)
@@ -538,6 +544,9 @@ class JaxBackend(ErasureBackend):
                 warnings.warn(
                     f"{err}; DEGRADED to the native CPU codec for the "
                     f"rest of this process", RuntimeWarning)
+            # lint: broad-except-ok warned + fully recomputed below:
+            # parity re-dispatches and every digest is re-hashed on the
+            # host, so the failed fused attempt contributes nothing
             except Exception as err:
                 import warnings
 

@@ -260,15 +260,14 @@ _PACKED_DEFAULT = False
 
 
 def _packed_enabled() -> bool:
-    """Standard env-flag parsing (utils/aio.py::mmap_opted_out): unset
+    """Standard env-flag parsing (cluster/tunables.env_flag): unset
     falls back to the process default; "", "0", "false", "no", "off"
-    mean off."""
-    import os
+    mean off.  Read at first dispatch and baked into jit caches — set
+    before the first encode (PARITY.md)."""
+    from chunky_bits_tpu.cluster.tunables import env_flag
 
-    v = os.environ.get("CHUNKY_BITS_TPU_PACKED_KERNEL")
-    if v is None:
-        return _PACKED_DEFAULT
-    return v.strip().lower() not in ("", "0", "false", "no", "off")
+    return env_flag("CHUNKY_BITS_TPU_PACKED_KERNEL",
+                    default=_PACKED_DEFAULT)
 
 
 def apply_m2_bitmajor(m2, shards, *, interpret: bool = False,

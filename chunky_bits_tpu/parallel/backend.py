@@ -61,7 +61,7 @@ class MeshJaxBackend(ErasureBackend):
     #: merged batcher dispatches amortize per-dispatch mesh RPC overhead
     prefers_merged_batches = True
 
-    def __init__(self, spec: str):
+    def __init__(self, spec: str) -> None:
         from chunky_bits_tpu.parallel import mesh as mesh_mod
 
         axes = parse_mesh_spec(spec)
@@ -129,7 +129,7 @@ class MeshJaxBackend(ErasureBackend):
             out = out[:b, :, :s]
         return np.ascontiguousarray(out)
 
-    def _cpu_fallback(self):
+    def _cpu_fallback(self) -> ErasureBackend:
         """The backend used once the mesh is marked dead mid-run."""
         if self._fallback is None:
             from chunky_bits_tpu.ops.backend import cpu_fallback_backend

@@ -46,6 +46,8 @@ def _distributed_initialized(jax) -> bool:
 
         state = getattr(_dist, "global_state", None)
         return getattr(state, "client", None) is not None
+    # lint: broad-except-ok defensive jax-internals probe; any failure
+    # must read as "not initialized", never crash backend resolution
     except Exception:
         return False
 

@@ -17,10 +17,14 @@ from typing import AsyncIterator, Optional, Protocol, runtime_checkable
 
 def mmap_opted_out() -> bool:
     """True when ``CHUNKY_BITS_TPU_NO_MMAP`` is set to a truthy value
-    (standard env-flag parsing: unset, "", "0", "false", "no", "off"
-    all mean the zero-copy mmap paths stay ON)."""
-    return os.environ.get("CHUNKY_BITS_TPU_NO_MMAP", "").strip().lower() \
-        not in ("", "0", "false", "no", "off")
+    (standard env-flag parsing — cluster/tunables.env_flag: unset, "",
+    "0", "false", "no", "off" all mean the zero-copy mmap paths stay
+    ON).  Read per call, at the moment each read path picks its
+    strategy — the import is local because tunables sits above this
+    module in the layering (tunables -> location -> aio)."""
+    from chunky_bits_tpu.cluster.tunables import env_flag
+
+    return env_flag("CHUNKY_BITS_TPU_NO_MMAP")
 
 
 @runtime_checkable

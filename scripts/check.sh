@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# The static-analysis gate — tier-1 (scripts/tier1.sh) and CI both run
+# this.  Two halves:
+#
+#   1. the project-native invariant linter (chunky_bits_tpu/analysis):
+#      pure stdlib AST rules, NO jax/numpy/aiohttp import, so it runs
+#      even when the device tunnel is down and on bare runners.  Always
+#      BLOCKING.
+#   2. mypy over the strict-typed surfaces ([tool.mypy] in
+#      pyproject.toml) — only when mypy is installed, and ADVISORY by
+#      default (MYPY_STRICT=1 makes it blocking).  The dev image cannot
+#      install mypy, so this half has never produced a recorded green
+#      run; until one exists it must not make THE gate fail on the one
+#      box that happens to have mypy while staying green everywhere
+#      else.  Flip the default to blocking once CI's mypy step records
+#      green.  Lint rule CB106 enforces annotation presence on the same
+#      modules regardless, so the typing floor never silently
+#      disappears with the tool.
+#
+# Exit code: non-zero when the linter fails (or mypy fails under
+# MYPY_STRICT=1).
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+python -m chunky_bits_tpu.analysis || exit $?
+
+if python -c "import mypy" >/dev/null 2>&1; then
+    if python -m mypy chunky_bits_tpu/ops chunky_bits_tpu/file \
+        chunky_bits_tpu/cluster chunky_bits_tpu/parallel; then
+        echo "check.sh: mypy half green"
+    elif [ "${MYPY_STRICT:-0}" = "1" ]; then
+        exit 1
+    else
+        echo "check.sh: WARNING mypy half failed (ADVISORY — set" \
+             "MYPY_STRICT=1 to make it blocking)" >&2
+    fi
+else
+    echo "check.sh: mypy not installed; skipped the mypy half" \
+         "(CB106 above still enforced annotation presence)"
+fi

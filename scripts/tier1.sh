@@ -1,5 +1,6 @@
 #!/usr/bin/env bash
-# The tier-1 verification gate — THE command builders and CI run, kept
+# The tier-1 verification gate — THE command builders and CI run.  The
+# static-analysis pre-step runs first; the pytest invocation is kept
 # byte-identical to the ROADMAP.md "Tier-1 verify" line so nobody gates
 # on a subtly different invocation:
 #   - CPU-only jax (never touches the flaky TPU tunnel),
@@ -10,6 +11,14 @@
 # Log lands in /tmp/_t1.log for postmortems.
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+# Static-analysis gate first (scripts/check.sh: the invariant linter +
+# mypy when installed).  Fast, CPU-only, no jax import — runs even when
+# the device tunnel is down.  The pytest invocation below additionally
+# re-runs the linter via tests/test_analysis.py::test_shipped_tree_is_clean,
+# so drivers invoking the ROADMAP.md pytest line directly still gate on it.
+bash scripts/check.sh || exit $?
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \

@@ -1,0 +1,507 @@
+"""The invariant linter (chunky_bits_tpu/analysis).
+
+Per-rule must-flag and must-pass fixture snippets, suppression-comment
+parsing, baseline round-trip, CLI exit codes — and the gate itself: the
+tree as shipped must be clean, which wires the analyzer into tier-1
+through plain pytest (no jax import anywhere in this file; the linter
+must run even when the device tunnel is down).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from chunky_bits_tpu.analysis import core, rules
+
+PKG_ROOT = Path(__file__).resolve().parents[1] / "chunky_bits_tpu"
+
+
+def run_snippet(tmp_path: Path, rel: str, source: str,
+                select: tuple[str, ...] = ()):
+    """Lint one fixture file placed at ``rel`` under a scratch root
+    (rule path scopes key off rel, e.g. 'ops/x.py')."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source), encoding="utf-8")
+    ruleset = [r for r in rules.ALL_RULES
+               if not select or r.id in select]
+    violations, errors = core.run_analysis(tmp_path, ruleset)
+    assert not errors, errors
+    return violations
+
+
+# ---- CB101 unbounded-await ----
+
+def test_unbounded_await_flags_event_wait(tmp_path):
+    vs = run_snippet(tmp_path, "ops/x.py", """
+        async def f(evt):
+            await evt.wait()
+    """, select=("CB101",))
+    assert [v.rule for v in vs] == ["CB101"]
+    assert "no deadline" in vs[0].message
+
+
+def test_unbounded_await_flags_bare_future(tmp_path):
+    vs = run_snippet(tmp_path, "parallel/x.py", """
+        async def f(fut):
+            return await fut
+    """, select=("CB101",))
+    assert [v.rule for v in vs] == ["CB101"]
+    assert "bare future" in vs[0].message
+
+
+def test_unbounded_await_passes_wait_for_and_plain_calls(tmp_path):
+    vs = run_snippet(tmp_path, "gateway/x.py", """
+        import asyncio
+
+        async def f(evt, reader):
+            await asyncio.wait_for(evt.wait(), 5.0)
+            data = await reader.read(4096)
+            await asyncio.sleep(1.0)
+            return data
+    """, select=("CB101",))
+    assert vs == []
+
+
+def test_unbounded_await_out_of_scope_paths_pass(tmp_path):
+    # cluster/ is not a device/network call path
+    vs = run_snippet(tmp_path, "cluster/x.py", """
+        async def f(evt):
+            await evt.wait()
+    """, select=("CB101",))
+    assert vs == []
+
+
+# ---- CB102 env-flag-discipline ----
+
+def test_env_read_flagged_outside_tunables(tmp_path):
+    vs = run_snippet(tmp_path, "ops/x.py", """
+        import os
+
+        def f():
+            return os.environ.get("CHUNKY_BITS_TPU_FOO")
+    """, select=("CB102",))
+    assert [v.rule for v in vs] == ["CB102"]
+    assert "CHUNKY_BITS_TPU_FOO" in vs[0].message
+
+
+def test_env_read_resolves_module_constants(tmp_path):
+    vs = run_snippet(tmp_path, "file/x.py", """
+        import os
+
+        KNOB = "CHUNKY_BITS_TPU_BAR"
+
+        def f():
+            return os.environ[KNOB]
+    """, select=("CB102",))
+    assert [v.rule for v in vs] == ["CB102"]
+
+
+def test_env_read_allowed_in_tunables_and_for_other_prefixes(tmp_path):
+    assert run_snippet(tmp_path, "cluster/tunables.py", """
+        import os
+
+        def env_str(name):
+            return os.environ.get(name, "")
+
+        def f():
+            return os.environ.get("CHUNKY_BITS_TPU_FOO")
+    """, select=("CB102",)) == []
+    assert run_snippet(tmp_path, "ops/y.py", """
+        import os
+
+        def f():
+            return os.environ.get("JAX_PLATFORMS")
+    """, select=("CB102",)) == []
+
+
+def test_env_write_not_flagged(tmp_path):
+    vs = run_snippet(tmp_path, "cli/x.py", """
+        import os
+
+        def f(v):
+            os.environ["CHUNKY_BITS_TPU_BACKEND"] = v
+    """, select=("CB102",))
+    assert vs == []
+
+
+# ---- CB103 non-daemon-thread ----
+
+def test_thread_rule_flags_pool_and_nondaemon_thread(tmp_path):
+    vs = run_snippet(tmp_path, "ops/x.py", """
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        def f(fn):
+            pool = ThreadPoolExecutor(max_workers=2)
+            t = threading.Thread(target=fn)
+            return pool, t
+    """, select=("CB103",))
+    assert [v.rule for v in vs] == ["CB103", "CB103"]
+
+
+def test_thread_rule_passes_daemon_thread_and_other_paths(tmp_path):
+    assert run_snippet(tmp_path, "ops/x.py", """
+        import threading
+
+        def f(fn):
+            return threading.Thread(target=fn, daemon=True)
+    """, select=("CB103",)) == []
+    # file/ (other than chunk_cache) is out of scope for CB103
+    assert run_snippet(tmp_path, "file/x.py", """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def f():
+            return ThreadPoolExecutor()
+    """, select=("CB103",)) == []
+
+
+# ---- CB104 broad-except ----
+
+def test_broad_except_flagged_without_justification(tmp_path):
+    vs = run_snippet(tmp_path, "file/x.py", """
+        def f():
+            try:
+                return 1
+            except Exception:
+                return None
+    """, select=("CB104",))
+    assert [v.rule for v in vs] == ["CB104"]
+
+
+def test_broad_except_terminal_raise_passes(tmp_path):
+    vs = run_snippet(tmp_path, "file/x.py", """
+        def f():
+            try:
+                return 1
+            except Exception as err:
+                raise RuntimeError("wrapped") from err
+    """, select=("CB104",))
+    assert vs == []
+
+
+def test_broad_except_narrow_type_passes(tmp_path):
+    vs = run_snippet(tmp_path, "file/x.py", """
+        def f():
+            try:
+                return 1
+            except (OSError, ValueError):
+                return None
+    """, select=("CB104",))
+    assert vs == []
+
+
+def test_broad_except_bare_and_tuple_flagged(tmp_path):
+    vs = run_snippet(tmp_path, "file/x.py", """
+        def f():
+            try:
+                return 1
+            except (ValueError, Exception):
+                return None
+
+        def g():
+            try:
+                return 1
+            except:  # noqa: E722
+                return None
+    """, select=("CB104",))
+    assert len(vs) == 2
+
+
+def test_noqa_ble001_with_reason_accepted(tmp_path):
+    vs = run_snippet(tmp_path, "file/x.py", """
+        def f():
+            try:
+                return 1
+            except Exception as err:  # noqa: BLE001 — surfaced upstream
+                return err
+    """, select=("CB104",))
+    assert vs == []
+
+
+# ---- CB105 jit-body hygiene ----
+
+def test_unrolled_range_loop_in_traced_fn_flagged(tmp_path):
+    vs = run_snippet(tmp_path, "ops/x.py", """
+        import jax.numpy as jnp
+
+        def compress(w):
+            for i in range(64):
+                w = w + jnp.tanh(w)
+            return w
+    """, select=("CB105",))
+    assert [v.rule for v in vs] == ["CB105"]
+    assert "fori_loop" in vs[0].message
+
+
+def test_host_side_range_loop_passes(tmp_path):
+    # no jnp/lax/pl reference in the function: host code, not a jit body
+    vs = run_snippet(tmp_path, "ops/x.py", """
+        def table():
+            return [i * 2 for i in range(256)] + [
+                j for j in range(256)]
+
+        def small(xs):
+            import jax.numpy as jnp
+            for i in range(8):
+                xs = jnp.roll(xs, 1)
+            return xs
+    """, select=("CB105",))
+    assert vs == []
+
+
+def test_device_concat_flagged(tmp_path):
+    vs = run_snippet(tmp_path, "ops/x.py", """
+        import jax.numpy as jnp
+
+        def f(a, b):
+            return jnp.concatenate([a, b], axis=1)
+    """, select=("CB105",))
+    assert [v.rule for v in vs] == ["CB105"]
+
+
+# ---- CB106 public-annotations ----
+
+def test_missing_annotations_flagged_on_strict_module(tmp_path):
+    vs = run_snippet(tmp_path, "ops/backend.py", """
+        class Coder:
+            def encode(self, data):
+                return data
+
+        def helper(x) -> int:
+            return x
+    """, select=("CB106",))
+    # encode: params + return; helper: params only
+    assert sorted(v.message.split()[2] for v in vs) == [
+        "encode()", "encode()", "helper()"]
+
+
+def test_private_and_nonstrict_modules_pass(tmp_path):
+    assert run_snippet(tmp_path, "ops/backend.py", """
+        def _internal(x):
+            return x
+    """, select=("CB106",)) == []
+    assert run_snippet(tmp_path, "ops/other.py", """
+        def public(x):
+            return x
+    """, select=("CB106",)) == []
+
+
+# ---- suppression parsing ----
+
+def test_suppression_same_line_and_line_above(tmp_path):
+    vs = run_snippet(tmp_path, "ops/x.py", """
+        async def f(evt, fut):
+            # lint: unbounded-await-ok winner always sets the event
+            await evt.wait()
+            return await fut  # lint: unbounded-await-ok drain resolves it
+    """, select=("CB101",))
+    assert vs == []
+
+
+def test_suppression_skips_continuation_comment_lines(tmp_path):
+    vs = run_snippet(tmp_path, "ops/x.py", """
+        async def f(evt):
+            # lint: unbounded-await-ok a justification long enough to
+            # wrap over two comment lines still covers the next code line
+            await evt.wait()
+    """, select=("CB101",))
+    assert vs == []
+
+
+def test_suppression_without_reason_does_not_suppress(tmp_path):
+    vs = run_snippet(tmp_path, "ops/x.py", """
+        async def f(evt):
+            await evt.wait()  # lint: unbounded-await-ok
+    """, select=("CB101",))
+    assert len(vs) == 1
+
+
+def test_suppression_wrong_slug_does_not_suppress(tmp_path):
+    vs = run_snippet(tmp_path, "ops/x.py", """
+        async def f(evt):
+            await evt.wait()  # lint: broad-except-ok wrong rule
+    """, select=("CB101",))
+    assert len(vs) == 1
+
+
+# ---- baseline round-trip ----
+
+def _sample_violations(tmp_path):
+    return run_snippet(tmp_path, "ops/x.py", """
+        import os
+
+        def f():
+            return os.environ.get("CHUNKY_BITS_TPU_FOO")
+
+        def g():
+            try:
+                return f()
+            except Exception:
+                return None
+    """)
+
+
+def test_baseline_round_trip(tmp_path):
+    vs = _sample_violations(tmp_path)
+    assert len(vs) == 2
+    baseline_path = tmp_path / "baseline.toml"
+    core.write_baseline(baseline_path, vs)
+    accepted = core.load_baseline(baseline_path)
+    assert accepted == {v.key() for v in vs}
+    # every finding baselined -> nothing new
+    assert [v for v in vs if v.key() not in accepted] == []
+
+
+def test_baseline_minimal_parser_matches_tomli(tmp_path):
+    vs = _sample_violations(tmp_path)
+    baseline_path = tmp_path / "baseline.toml"
+    core.write_baseline(baseline_path, vs)
+    text = baseline_path.read_text(encoding="utf-8")
+    mini = core._parse_minimal_toml(text)
+    assert {(e["rule"], e["path"], e["fingerprint"])
+            for e in mini["violation"]} == {v.key() for v in vs}
+
+
+def test_baseline_fingerprint_survives_line_motion(tmp_path):
+    before = _sample_violations(tmp_path)
+    after = run_snippet(tmp_path, "ops/x.py", """
+        import os
+
+        # an unrelated comment pushed everything down
+
+
+        def f():
+            return os.environ.get("CHUNKY_BITS_TPU_FOO")
+
+        def g():
+            try:
+                return f()
+            except Exception:
+                return None
+    """)
+    assert {v.key() for v in before} == {v.key() for v in after}
+    assert [v.line for v in before] != [v.line for v in after]
+
+
+def test_missing_baseline_is_empty(tmp_path):
+    assert core.load_baseline(tmp_path / "nope.toml") == set()
+
+
+def test_corrupt_baseline_raises_clean_diagnostic(tmp_path):
+    """A hand-edit typo must fail loudly with the file named, never as
+    a raw decoder traceback or a silently-shrunk accepted set."""
+    bad = tmp_path / "baseline.toml"
+    bad.write_text('[[violation]]\nrule = "unterminated\n',
+                   encoding="utf-8")
+    with pytest.raises(ValueError, match="baseline .*unparseable"):
+        core.load_baseline(bad)
+    proc = _run_cli("--baseline", str(bad))
+    assert proc.returncode == 2
+    assert "unparseable" in proc.stderr
+
+
+def test_files_outside_root_are_an_error_not_a_silent_skip(tmp_path):
+    """A file whose rel path can't resolve against --root would dodge
+    every path-scoped rule; that's an error, not a clean scan."""
+    outside = tmp_path / "backend.py"
+    outside.write_text(
+        "import threading\n\n\ndef f(fn):\n"
+        "    return threading.Thread(target=fn)\n", encoding="utf-8")
+    root = tmp_path / "pkg"
+    root.mkdir()
+    violations, errors = core.run_analysis(root, rules.ALL_RULES,
+                                           files=[outside])
+    assert violations == []
+    assert len(errors) == 1 and "outside --root" in errors[0]
+
+
+def test_unparseable_file_is_an_error_not_a_skip(tmp_path):
+    path = tmp_path / "ops" / "bad.py"
+    path.parent.mkdir(parents=True)
+    path.write_text("def broken(:\n", encoding="utf-8")
+    violations, errors = core.run_analysis(tmp_path, rules.ALL_RULES)
+    assert violations == []
+    assert len(errors) == 1 and "bad.py" in errors[0]
+
+
+# ---- the gate itself (CLI contract + shipped-tree cleanliness) ----
+
+def _run_cli(*args, cwd=None):
+    return subprocess.run(
+        [sys.executable, "-m", "chunky_bits_tpu.analysis", *args],
+        capture_output=True, text=True, timeout=120,
+        cwd=cwd or str(PKG_ROOT.parent))
+
+
+@pytest.mark.filterwarnings("ignore")
+def test_shipped_tree_is_clean():
+    """THE acceptance gate: the analyzer exits 0 on the tree as
+    shipped.  A new violation anywhere in chunky_bits_tpu/ fails
+    tier-1 right here."""
+    proc = _run_cli()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ok:" in proc.stdout
+
+
+def test_cli_fails_on_introduced_violation(tmp_path):
+    """End-to-end: introducing a fixture violation into a scanned tree
+    turns the exit code non-zero (the ISSUE's acceptance criterion)."""
+    scratch = tmp_path / "pkg"
+    (scratch / "ops").mkdir(parents=True)
+    (scratch / "ops" / "fresh.py").write_text(
+        "import os\n\n\ndef f():\n"
+        "    return os.environ.get('CHUNKY_BITS_TPU_NEW_KNOB')\n",
+        encoding="utf-8")
+    proc = _run_cli("--root", str(scratch), "--baseline",
+                    str(tmp_path / "empty.toml"))
+    assert proc.returncode == 1
+    assert "CB102" in proc.stdout
+
+
+def test_cli_write_baseline_refuses_restricted_scans(tmp_path):
+    """A --select/path-restricted scan sees only a subset of findings;
+    writing that subset out would drop every accepted entry outside it
+    (and the next full run would fail on the re-surfaced findings)."""
+    for args in (("--select", "CB101", "--write-baseline"),
+                 (str(PKG_ROOT / "file"), "--write-baseline")):
+        proc = _run_cli(*args, "--baseline", str(tmp_path / "b.toml"))
+        assert proc.returncode == 2
+        assert "full scan" in proc.stderr
+        assert not (tmp_path / "b.toml").exists()
+
+
+def test_cli_write_baseline_refuses_scan_with_file_errors(tmp_path):
+    """An unparseable file's accepted findings are missing from the
+    scan; writing the baseline anyway would drop them silently."""
+    scratch = tmp_path / "pkg"
+    (scratch / "ops").mkdir(parents=True)
+    (scratch / "ops" / "bad.py").write_text("def broken(:\n",
+                                            encoding="utf-8")
+    proc = _run_cli("--root", str(scratch), "--write-baseline",
+                    "--baseline", str(tmp_path / "b.toml"))
+    assert proc.returncode == 2
+    assert "file errors" in proc.stderr
+    assert not (tmp_path / "b.toml").exists()
+
+
+def test_cli_list_rules_names_all_six():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rid in ("CB101", "CB102", "CB103", "CB104", "CB105", "CB106"):
+        assert rid in proc.stdout
+
+
+def test_cli_json_contract():
+    import json
+
+    proc = _run_cli("--json")
+    assert proc.returncode == 0
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    assert payload["new"] == []
