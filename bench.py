@@ -41,6 +41,12 @@ def _outage_record(metric: str) -> str:
     })
 
 
+def nproc() -> int:
+    import os
+
+    return os.cpu_count() or 1
+
+
 def _env_shrink(name: str, default: float) -> float:
     """Test-seam env override that can only SHRINK ``default``:
     malformed, non-positive, or larger values fall back, so inherited
@@ -404,10 +410,15 @@ def bench_cp_pipeline(argv: list) -> None:
 
     Flags: ``--gib N`` stream size (default 1), ``--backend X`` (default
     jax), ``--batch N`` (default 256 per BASELINE.md:32), ``--no-hash``
-    to skip per-shard SHA-256 — on this 1-core host the hash caps the
+    to skip per-shard SHA-256 — on a 1-core host the hash caps the
     full pipeline at ~1.8 GiB/s (a host-core artifact, not a design
     signal), so --no-hash isolates the staging + device-encode pipeline
-    the config exists to measure.  NOTE: under the tunneled dev chip,
+    the config exists to measure.  ``--threads N`` pins the host plane
+    to N total threads (``native:N`` codec + an N-worker HostPipeline);
+    ``--sweep-threads 1,2[,4...]`` runs the whole measurement once per N
+    and prints one JSON line each — the host-scaling harness for the
+    full streamed-ingest pipeline (the config-4 sweep covers only the
+    batcher's compute core).  NOTE: under the tunneled dev chip,
     host->device bandwidth is ~25 MiB/s, so the jax backend here is
     tunnel-bound (see BASELINE.md "tunnel ceiling"); on co-located TPU
     hardware the same path rides PCIe/ICI."""
@@ -426,6 +437,17 @@ def bench_cp_pipeline(argv: list) -> None:
     batch = flag("--batch", 256, int)
     stage = flag("--stage", 8, int)
     no_hash = "--no-hash" in argv
+    threads = flag("--threads", None, str)
+    sweep = flag("--sweep-threads", None, str)
+    if threads and sweep:
+        print("--threads and --sweep-threads conflict; pick one",
+              file=sys.stderr)
+        sys.exit(2)
+    # each sweep entry pins TOTAL host threads: the native:N codec cap
+    # plus an N-worker HostPipeline, so N=1 really is one host thread
+    # and the N=1 vs N=2 A/B measures core scaling, not oversubscription
+    thread_list = ([int(x) for x in sweep.split(",")] if sweep
+                   else [int(threads)] if threads else [None])
     # --src file: materialize the stream to a temp file and ingest via
     # aio.FileReader — engages the writer's zero-copy mmap view path,
     # i.e. the real `cp local-file cluster#x` shape.  Default "cyclic"
@@ -486,16 +508,23 @@ def bench_cp_pipeline(argv: list) -> None:
     batcher_cls = NoHashBatcher if no_hash else EncodeHashBatcher
     batcher_box = {}
 
-    def make_batcher():
-        batcher_box["b"] = batcher_cls(backend=backend, max_batch=batch)
-        return batcher_box["b"]
-
-    ready = _arm_if_device_backend(
-        backend, "cp_pipeline_encode_gibps_d10p4_1mib_b" + str(batch)
+    # --threads/--sweep-threads pins native:N, which cannot hang on
+    # device init — skip the watchdog entirely (passing None would fall
+    # back to $CHUNKY_BITS_TPU_BACKEND and probe a device the sweep
+    # never touches)
+    ready = (None if thread_list != [None] else _arm_if_device_backend(
+        backend,
+        "cp_pipeline_encode_gibps_d10p4_1mib_b" + str(batch)
         + ("_nohash" if no_hash else "")
-        + ("_mmap" if src == "file" else ""))
+        + ("_mmap" if src == "file" else "")))
 
-    async def run() -> tuple:
+    async def run(run_backend, pipeline) -> tuple:
+        def make_batcher():
+            batcher_box["b"] = batcher_cls(backend=run_backend,
+                                           max_batch=batch,
+                                           host_pipeline=pipeline)
+            return batcher_box["b"]
+
         builder = (FileWriteBuilder()
                    .with_destination(None)  # VoidDestination
                    .with_chunk_size(chunk)
@@ -503,8 +532,10 @@ def bench_cp_pipeline(argv: list) -> None:
                    .with_concurrency(batch + 4)
                    .with_batch_parts(batch)
                    .with_stage_parts(stage)
-                   .with_backend(backend)
+                   .with_backend(run_backend)
                    .with_encode_batcher(make_batcher))
+        if pipeline is not None:
+            builder = builder.with_host_pipeline(pipeline)
         # warm (compile, thread pools) with one small batch
         await (builder.with_batch_parts(2).with_concurrency(6)
                .write(CyclicReader(2 * part_bytes)))
@@ -542,45 +573,96 @@ def bench_cp_pipeline(argv: list) -> None:
             print(f"usage: bench.py --config 2 --src {{cyclic,file}} "
                   f"(got {src!r})", file=sys.stderr)
             sys.exit(2)
-        ref, dt, dispatches = asyncio.run(run())
-    n_parts = len(ref.parts)
-    assert n_parts == total // part_bytes
-    gibps = total / dt / (1 << 30)
-    per_dispatch = n_parts / max(dispatches, 1)
-    print(f"# config 2: {total / (1 << 30):.1f} GiB through "
-          f"FileWriteBuilder, backend={backend}, batch={batch}, "
-          f"src={src}, hash={'off' if no_hash else 'on'}; {n_parts} "
-          f"parts in {dispatches} dispatches "
-          f"({per_dispatch:.1f} parts/dispatch)",
-          file=sys.stderr)
-    print(json.dumps({
-        "metric": "cp_pipeline_encode_gibps_d10p4_1mib_b" + str(batch)
-                  + ("_nohash" if no_hash else "")
-                  + ("_mmap" if src == "file" else ""),
-        "value": round(gibps, 2), "unit": "GiB/s",
-        "vs_baseline": round(gibps / 5.0, 2),
-        "parts_per_dispatch": round(per_dispatch, 1),
-    }))
+
+        for n_threads in thread_list:
+            if n_threads is None:
+                run_backend, pipeline, suffix = backend, None, ""
+            else:
+                # pin TOTAL host threads: native:N codec cap + an
+                # N-worker pipeline (writer compute rides the pipeline)
+                from chunky_bits_tpu.parallel.host_pipeline import \
+                    HostPipeline
+
+                run_backend = f"native:{n_threads}"
+                pipeline = HostPipeline(threads=n_threads)
+                suffix = f"_host{n_threads}"
+            ref, dt, dispatches = asyncio.run(run(run_backend, pipeline))
+            if pipeline is not None:
+                stats = pipeline.stats()
+                pipeline.close()
+            else:
+                stats = None
+            n_parts = len(ref.parts)
+            assert n_parts == total // part_bytes
+            gibps = total / dt / (1 << 30)
+            per_dispatch = n_parts / max(dispatches, 1)
+            print(f"# config 2: {total / (1 << 30):.1f} GiB through "
+                  f"FileWriteBuilder, backend={run_backend}, "
+                  f"batch={batch}, src={src}, "
+                  f"hash={'off' if no_hash else 'on'}; {n_parts} "
+                  f"parts in {dispatches} dispatches "
+                  f"({per_dispatch:.1f} parts/dispatch)"
+                  + (f"; {stats}" if stats is not None else ""),
+                  file=sys.stderr)
+            print(json.dumps({
+                "metric": "cp_pipeline_encode_gibps_d10p4_1mib_b"
+                          + str(batch)
+                          + ("_nohash" if no_hash else "")
+                          + ("_mmap" if src == "file" else "") + suffix,
+                "value": round(gibps, 2), "unit": "GiB/s",
+                "vs_baseline": round(gibps / 5.0, 2),
+                "parts_per_dispatch": round(per_dispatch, 1),
+                **({"host_threads": n_threads, "host_cores": nproc()}
+                   if n_threads is not None else {}),
+            }))
 
 
-def bench_batched_repair() -> None:
+def bench_batched_repair(argv=()) -> None:
     """BASELINE.md config 3's host-path shape: many degraded parts
     sharing one erasure pattern (the common node-loss case) rebuilt
     through the ReconstructBatcher's coalesced dispatches — the repair
-    analogue of config 4.  Single JSON line on stdout."""
+    analogue of config 4.  One JSON line on stdout per run.
+
+    ``--threads N`` pins the decode to N host threads (the ``native:N``
+    codec spec bounding the batched GF matmul's std::thread fan-out);
+    ``--sweep-threads 1,2[,4...]`` runs the measurement once per N, one
+    JSON line each — the decode-side host-scaling harness, mirroring
+    configs 2 and 4."""
     import asyncio
+
+    argv = list(argv)
+
+    def flag_val(name):
+        if name in argv:
+            idx = argv.index(name) + 1
+            if idx >= len(argv):
+                print(f"usage: bench.py --config 3 [{name} N[,N...]]",
+                      file=sys.stderr)
+                sys.exit(2)
+            return argv[idx]
+        return None
+
+    threads = flag_val("--threads")
+    sweep = flag_val("--sweep-threads")
+    if threads and sweep:
+        print("--threads and --sweep-threads conflict; pick one",
+              file=sys.stderr)
+        sys.exit(2)
+    specs = ([f"native:{n}" for n in sweep.split(",")] if sweep
+             else [f"native:{threads}" if threads else None])
 
     from chunky_bits_tpu.ops.backend import ErasureCoder, get_backend
     from chunky_bits_tpu.ops.batching import ReconstructBatcher
 
     d, p, size = 10, 4, 1 << 20
     # armed before the prep encodes below — they hit the device too when
-    # $CHUNKY_BITS_TPU_BACKEND selects a jax backend
-    ready = _arm_if_device_backend(
-        None, "batched_repair_reconstruct_gibps_d10p4_4erasures")
+    # $CHUNKY_BITS_TPU_BACKEND selects a jax backend (an explicit
+    # --threads/--sweep-threads run pins native:N, which cannot hang)
+    ready = (None if (threads or sweep) else _arm_if_device_backend(
+        None, "batched_repair_reconstruct_gibps_d10p4_4erasures"))
     n_parts = 40
     rng = np.random.default_rng(0)
-    coder = ErasureCoder(d, p, get_backend())
+    coder = ErasureCoder(d, p, get_backend(specs[0]))
     parts = []
     for _ in range(n_parts):
         data = rng.integers(0, 256, (1, d, size), dtype=np.uint8)
@@ -591,8 +673,8 @@ def bench_batched_repair() -> None:
             rows[i] = None
         parts.append(rows)
 
-    async def run() -> float:
-        batcher = ReconstructBatcher()
+    async def run(backend) -> float:
+        batcher = ReconstructBatcher(backend=backend)
         sem = asyncio.Semaphore(10)  # resilver's in-flight bound
 
         async def one(rows):
@@ -610,12 +692,16 @@ def bench_batched_repair() -> None:
               file=sys.stderr)
         return (n_parts - 1) * d * size / dt / (1 << 30)
 
-    gib = asyncio.run(run())
-    print(json.dumps({
-        "metric": "batched_repair_reconstruct_gibps_d10p4_4erasures",
-        "value": round(gib, 2), "unit": "GiB/s",
-        "vs_baseline": round(gib / 5.0, 2),
-    }))
+    for backend in specs:
+        gib = asyncio.run(run(backend))
+        print(json.dumps({
+            "metric": "batched_repair_reconstruct_gibps_d10p4_4erasures"
+                      + (f"_{backend.replace(':', '')}" if backend
+                         else ""),
+            "value": round(gib, 2), "unit": "GiB/s",
+            "vs_baseline": round(gib / 5.0, 2),
+            **({"host_cores": nproc()} if sweep else {}),
+        }))
 
 
 def bench_hot_read(argv=()) -> None:
@@ -716,6 +802,129 @@ def bench_hot_read(argv=()) -> None:
     }))
 
 
+def bench_gateway_put(argv=()) -> None:
+    """Gateway PUT ingest: a multi-GiB body streamed through a REAL
+    aiohttp server into the full encode+hash+place pipeline (the
+    BASELINE "CLI host plane" row's gateway PUT shape, measurable and
+    re-runnable instead of hand-driven curl).  CPU-only — no device, no
+    watchdog.  One JSON line per run.
+
+    The A/B this config exists for: ``--threads N`` pins the cluster's
+    host plane to N total threads (``tunables.host_threads`` + the
+    ``native:N`` codec spec), so N=1 vs N=2 measures whether socket
+    receive and encode+hash actually overlap across cores.
+    ``--sweep-threads 1,2[,...]`` emits one line per N.
+
+    Flags: ``--gib N`` body size (default 1), ``--trials N`` (default 3,
+    best-of reported), ``--threads N`` / ``--sweep-threads N,N``."""
+    import asyncio
+    import contextlib
+    import os
+    import tempfile
+
+    argv = list(argv)
+
+    def flag(name, default, cast):
+        if name in argv:
+            return cast(argv[argv.index(name) + 1])
+        return default
+
+    gib = flag("--gib", 1.0, float)
+    trials = flag("--trials", 3, int)
+    threads = flag("--threads", None, str)
+    sweep = flag("--sweep-threads", None, str)
+    if threads and sweep:
+        print("--threads and --sweep-threads conflict; pick one",
+              file=sys.stderr)
+        sys.exit(2)
+    thread_list = ([int(x) for x in sweep.split(",")] if sweep
+                   else [int(threads)] if threads else [0])
+
+    from aiohttp import ClientSession, ClientTimeout
+    from aiohttp.test_utils import TestServer
+
+    from chunky_bits_tpu.cluster import Cluster
+    from chunky_bits_tpu.gateway import make_app
+
+    total = int(gib * (1 << 30))
+    blob = np.random.default_rng(0).integers(
+        0, 256, 8 << 20, dtype=np.uint8).tobytes()
+
+    def make_cluster(root: str, n_threads: int) -> Cluster:
+        dirs = []
+        for i in range(5):
+            d = os.path.join(root, f"disk{i}")
+            os.makedirs(d, exist_ok=True)
+            dirs.append(d)
+        meta = os.path.join(root, "meta")
+        os.makedirs(meta, exist_ok=True)
+        tunables = {"backend": f"native:{n_threads}" if n_threads
+                    else "native"}
+        if n_threads:
+            tunables["host_threads"] = n_threads
+        return Cluster.from_obj({
+            "destinations": [{"location": d} for d in dirs],
+            "metadata": {"type": "path", "format": "yaml", "path": meta},
+            # the reference's default geometry (writer.rs:50-59): d=3
+            # p=2, 1 MiB chunks — the BASELINE round-5 PUT row's shape
+            "profiles": {"default": {"data": 3, "parity": 2,
+                                     "chunk_size": 20}},
+            "tunables": tunables,
+        })
+
+    async def body():
+        sent = 0
+        view = memoryview(blob)
+        while sent < total:
+            n = min(len(blob), total - sent)
+            yield view[:n]
+            sent += n
+
+    async def run_one(n_threads: int) -> float:
+        best = float("inf")
+        with contextlib.ExitStack() as stack:
+            root = stack.enter_context(tempfile.TemporaryDirectory())
+            cluster = make_cluster(root, n_threads)
+            server = TestServer(make_app(cluster))
+            await server.start_server()
+            try:
+                timeout = ClientTimeout(total=3600)
+                async with ClientSession(timeout=timeout) as session:
+                    # warm: thread pools, first-dispatch codec resolution
+                    resp = await session.put(server.make_url("/warm"),
+                                             data=blob[:1 << 20])
+                    assert resp.status == 200, resp.status
+                    for t in range(trials):
+                        t0 = time.perf_counter()
+                        resp = await session.put(
+                            server.make_url(f"/obj{t}"), data=body())
+                        dt = time.perf_counter() - t0
+                        assert resp.status == 200, resp.status
+                        best = min(best, dt)
+            finally:
+                await server.close()
+                await cluster.tunables.location_context().aclose()
+                if n_threads:
+                    # cluster-pinned pipeline: stop its workers so a
+                    # sweep doesn't accumulate thread sets across runs
+                    cluster.host_pipeline().close()
+        return total / best / (1 << 30)
+
+    for n_threads in thread_list:
+        gibps = asyncio.run(run_one(n_threads))
+        label = n_threads if n_threads else "auto"
+        print(f"# config 7: gateway PUT {gib:g} GiB, d=3 p=2 native, "
+              f"host_threads={label}, best of {trials}: "
+              f"{gibps:.3f} GiB/s", file=sys.stderr)
+        print(json.dumps({
+            "metric": "gateway_put_ingest_gibps_d3p2_1mib"
+                      + (f"_host{n_threads}" if n_threads else ""),
+            "value": round(gibps, 3), "unit": "GiB/s",
+            "vs_baseline": round(gibps / 5.0, 3),
+            "host_cores": nproc(),
+        }))
+
+
 def bench_small_objects(argv=()) -> None:
     """BASELINE.md config 4's compute core: many concurrent small-object
     encodes (d=8 p=3, 4 MiB objects => [1, 8, S] batches) coalescing
@@ -806,16 +1015,18 @@ if __name__ == "__main__":
     if "--config" in sys.argv:
         configs = {"1": bench_cpu_reference,
                    "2": lambda: bench_cp_pipeline(sys.argv),
-                   "3": bench_batched_repair,
+                   "3": lambda: bench_batched_repair(sys.argv),
                    "4": lambda: bench_small_objects(sys.argv),
-                   "6": lambda: bench_hot_read(sys.argv)}
+                   "6": lambda: bench_hot_read(sys.argv),
+                   "7": lambda: bench_gateway_put(sys.argv)}
         idx = sys.argv.index("--config") + 1
         which = sys.argv[idx] if idx < len(sys.argv) else ""
         if which not in configs:
-            print(f"usage: bench.py [--config {{1,2,3,4,6}}] — the device "
-                  f"kernel metric (configs 2+3's compute core) is the "
-                  f"default no-arg run (got {which!r}); 6 is the "
-                  f"hot-read cache A/B", file=sys.stderr)
+            print(f"usage: bench.py [--config {{1,2,3,4,6,7}}] — the "
+                  f"device kernel metric (configs 2+3's compute core) is "
+                  f"the default no-arg run (got {which!r}); 6 is the "
+                  f"hot-read cache A/B, 7 the gateway PUT ingest A/B "
+                  f"(both CPU-only)", file=sys.stderr)
             sys.exit(2)
         configs[which]()
     else:

@@ -14,6 +14,7 @@ default of 2); that bug is fixed here, matching the behavior of its own
 from __future__ import annotations
 
 import asyncio
+import threading
 import weakref
 from collections import OrderedDict
 from typing import Optional, Union
@@ -57,6 +58,13 @@ class Cluster:
         # read that was in flight across a write of the same path
         self._file_refs: "OrderedDict[str, FileReference]" = OrderedDict()
         self._file_ref_gen = 0
+        # cluster-pinned host pipeline (tunables.host_threads > 0), else
+        # the process-shared one (see host_pipeline()); the lock makes
+        # first-use construction single — clusters are already used from
+        # multiple event loops in different threads (see the per-loop
+        # batcher maps), and a lost race would leak a worker set
+        self._own_host_pipeline = None
+        self._host_pipeline_lock = threading.Lock()
 
     # ---- serde ----
 
@@ -134,9 +142,35 @@ class Cluster:
         if batcher is None:
             from chunky_bits_tpu.ops.batching import EncodeHashBatcher
 
-            batcher = EncodeHashBatcher(backend=self.tunables.backend)
+            batcher = EncodeHashBatcher(backend=self.tunables.backend,
+                                        host_pipeline=self.host_pipeline())
             self._encode_batchers[loop] = batcher
         return batcher
+
+    def host_pipeline(self):
+        """This cluster's host compute executor (per-shard SHA-256 +
+        per-stripe GF encode workers, parallel/host_pipeline.py): a
+        cluster-pinned instance when ``tunables.host_threads`` is set in
+        cluster.yaml, else the process-shared auto-sized pipeline.  Every
+        ingest path of this cluster (write_file, gateway PUT) draws from
+        it, so the thread budget is one knob, not per-call-site.  Known
+        exception: a *device* backend's internal ingest hashing
+        (jax_backend.encode_and_hash, mesh async-dispatch) rides the
+        process-shared pipeline, whose size the
+        ``CHUNKY_BITS_TPU_HOST_THREADS`` env var caps — backends have no
+        cluster context to thread the pinned instance through."""
+        from chunky_bits_tpu.parallel.host_pipeline import (
+            HostPipeline,
+            get_host_pipeline,
+        )
+
+        n = self.tunables.host_threads
+        if n <= 0:
+            return get_host_pipeline()
+        with self._host_pipeline_lock:
+            if self._own_host_pipeline is None:
+                self._own_host_pipeline = HostPipeline(threads=n)
+            return self._own_host_pipeline
 
     def get_file_writer(self, profile: ClusterProfile) -> FileWriteBuilder:
         # Staging several parts per encode dispatch amortizes per-part
@@ -159,6 +193,7 @@ class Cluster:
             .with_backend(self.tunables.backend)
             .with_batch_parts(batch_parts)
             .with_encode_batcher(self._encode_batcher)
+            .with_host_pipeline(self.host_pipeline())
         )
 
     async def write_file_ref(self, path: str,
@@ -263,6 +298,7 @@ class Cluster:
             .with_backend(self.tunables.backend)
             .with_batcher(self._reconstruct_batcher())
             .with_cache(self._chunk_cache())
+            .with_pipeline(self.host_pipeline())
         )
 
     async def read_file(self, path: str) -> aio.AsyncByteReader:

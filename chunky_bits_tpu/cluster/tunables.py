@@ -16,6 +16,12 @@ cache on the serve path: verified chunk buffers keyed by sha256 digest,
 plus the cluster's FileReference metadata cache.  YAML wins; the
 ``CHUNKY_BITS_TPU_CACHE_BYTES`` env var supplies the default so an
 operator can turn the cache on without editing cluster.yaml.
+
+``host_threads`` (TPU-repo extension, default 0 = auto) sizes the host
+compute pipeline (parallel/host_pipeline.py) that runs per-shard
+SHA-256 and per-stripe GF(2^8) encode for this cluster's ingest and
+verify paths; same YAML-wins/env-default split via
+``CHUNKY_BITS_TPU_HOST_THREADS``.
 """
 
 from __future__ import annotations
@@ -28,6 +34,12 @@ from chunky_bits_tpu.errors import SerdeError
 from chunky_bits_tpu.file.location import IGNORE, OVERWRITE, LocationContext
 
 CACHE_BYTES_ENV = "CHUNKY_BITS_TPU_CACHE_BYTES"
+
+#: host compute worker count for the shared host pipeline
+#: (parallel/host_pipeline.py): per-shard SHA-256 + per-stripe GF encode
+#: workers.  0/unset = auto (one per core).  Read at first dispatch —
+#: the shared pipeline is built once per process.
+HOST_THREADS_ENV = "CHUNKY_BITS_TPU_HOST_THREADS"
 
 #: the backend-selection handoff: the CLI --backend flag writes it, the
 #: default resolution in ops/backend.get_backend reads it
@@ -83,6 +95,29 @@ def env_seconds(name: str, *, default: float) -> float:
         raise ValueError(f"bad ${name}={raw!r} (want seconds)") from None
 
 
+def host_threads(*, default: int = 0) -> int:
+    """Requested host compute worker count from
+    ``$CHUNKY_BITS_TPU_HOST_THREADS``; unset/malformed/non-positive reads
+    as ``default`` (0 = auto: one worker per core).  Lenient like
+    ``cache_bytes`` — a perf knob can only *tune*, never crash, process
+    startup.  The scheduler itself clamps to ``min(N, nproc)`` for the
+    shared pipeline (parallel/host_pipeline.get_host_pipeline); explicit
+    ``HostPipeline(threads=N)`` instances honor N exactly so scaling
+    sweeps and tests can oversubscribe deliberately."""
+    raw = os.environ.get(HOST_THREADS_ENV, "")
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def _default_host_threads() -> int:
+    """Env-supplied default for the ``host_threads`` tunable (YAML wins;
+    0 = auto/shared pipeline)."""
+    return host_threads(default=0)
+
+
 def _default_cache_bytes() -> int:
     """Env-supplied default; malformed or negative values read as off
     (the knob can only *enable*, never crash, config loading)."""
@@ -103,6 +138,11 @@ class Tunables:
     #: read-cache byte budget; 0 disables (the default — opt-in until
     #: measured, per CLAUDE.md)
     cache_bytes: int = field(default_factory=_default_cache_bytes)
+    #: host pipeline worker count for this cluster's ingest/verify
+    #: compute (per-shard SHA-256 + per-stripe GF encode); 0 = use the
+    #: process-shared auto-sized pipeline.  YAML wins; the
+    #: ``CHUNKY_BITS_TPU_HOST_THREADS`` env var supplies the default.
+    host_threads: int = field(default_factory=_default_host_threads)
 
     def is_device_backend(self) -> bool:
         """True when the erasure plane runs on an accelerator ("jax" or a
@@ -136,6 +176,16 @@ class Tunables:
             if cache_bytes < 0:
                 raise SerdeError(
                     f"cache_bytes must be >= 0, got {cache_bytes}")
+        host_threads_v = obj.get("host_threads", None)
+        if host_threads_v is not None:
+            try:
+                host_threads_v = int(host_threads_v)
+            except (TypeError, ValueError) as err:
+                raise SerdeError(
+                    f"invalid host_threads {host_threads_v!r}") from err
+            if host_threads_v < 0:
+                raise SerdeError(
+                    f"host_threads must be >= 0, got {host_threads_v}")
         return cls(
             https_only=bool(obj.get("https_only", False)),
             on_conflict=on_conflict,
@@ -143,6 +193,8 @@ class Tunables:
             backend=obj.get("backend"),
             **({"cache_bytes": cache_bytes}
                if cache_bytes is not None else {}),
+            **({"host_threads": host_threads_v}
+               if host_threads_v is not None else {}),
         )
 
     def to_obj(self) -> dict:
@@ -155,6 +207,8 @@ class Tunables:
             obj["backend"] = self.backend
         if self.cache_bytes > 0:
             obj["cache_bytes"] = self.cache_bytes
+        if self.host_threads > 0:
+            obj["host_threads"] = self.host_threads
         return obj
 
     def location_context(self) -> LocationContext:

@@ -28,18 +28,19 @@ from chunky_bits_tpu.errors import (
     ShardError,
 )
 from chunky_bits_tpu.file.chunk import Chunk
-from chunky_bits_tpu.file.hashing import AnyHash, Sha256Hash, hash_buf_async
+from chunky_bits_tpu.file.hashing import AnyHash, Sha256Hash
 from chunky_bits_tpu.file.location import Location, LocationContext, \
     default_context
 from chunky_bits_tpu.ops import ErasureCoder, get_coder
 from chunky_bits_tpu.utils import aio
 
-if TYPE_CHECKING:  # typing-only: neither module is needed at import time
+if TYPE_CHECKING:  # typing-only: none of these is needed at import time
     from chunky_bits_tpu.file.chunk_cache import ChunkCache
     from chunky_bits_tpu.file.collection_destination import (
         CollectionDestination,
     )
     from chunky_bits_tpu.ops.batching import ReconstructBatcher
+    from chunky_bits_tpu.parallel.host_pipeline import HostPipeline
 
 #: buffer-protocol payloads the codec surfaces accept (numpy rows are
 #: normalized to memoryview at the boundaries that take them)
@@ -82,11 +83,34 @@ class FileIntegrity(enum.IntEnum):
         return self.name.capitalize()
 
 
+def _pipe(pipeline: Optional["HostPipeline"] = None) -> "HostPipeline":
+    """The host compute executor for this call: the injected one
+    (verify/resilver fan-outs share a single instance; sweeps pin N) or
+    the process-shared pipeline.  Hash verification hops here instead of
+    ``asyncio.to_thread`` so every host path draws from the same bounded
+    ``min(N, nproc)`` daemon worker set and shows up in the profiler's
+    per-stage counters."""
+    if pipeline is not None:
+        return pipeline
+    from chunky_bits_tpu.parallel.host_pipeline import get_host_pipeline
+
+    return get_host_pipeline()
+
+
+def _buf_len(data: object) -> int:
+    try:
+        return len(memoryview(data))  # type: ignore[arg-type]
+    except TypeError:
+        return 0
+
+
 _FUSED_HASHER = None  # resolved once: sha256_file or False
 
 
 async def _hash_local_fused(chunk: Chunk, location: Location,
-                            cx: LocationContext) -> Optional[bytes]:
+                            cx: LocationContext,
+                            pipeline: Optional["HostPipeline"] = None
+                            ) -> Optional[bytes]:
     """Digest of a local chunk file via the native streaming read+hash
     pass (C++ SHA-NI; ops/cpu_backend.sha256_file), which never surfaces
     the bytes to Python.  Returns None when the fast path doesn't apply —
@@ -112,10 +136,13 @@ async def _hash_local_fused(chunk: Chunk, location: Location,
             _FUSED_HASHER = False
     if _FUSED_HASHER is False:
         return None
+    hasher = _FUSED_HASHER
     try:
-        return await asyncio.to_thread(
-            _FUSED_HASHER, location.target,
-            location.range.start or 0, location.range.length)
+        return await _pipe(pipeline).run(
+            "verify",
+            lambda: hasher(location.target, location.range.start or 0,
+                           location.range.length),
+            nbytes=location.range.length or 0)
     except OSError:
         return None
 
@@ -204,17 +231,20 @@ class FilePart:
                    coder: Optional[ErasureCoder] = None,
                    backend: Optional[str] = None,
                    batcher: Optional[ReconstructBatcher] = None,
-                   cache: Optional[ChunkCache] = None) -> bytes:
+                   cache: Optional[ChunkCache] = None,
+                   pipeline: Optional[HostPipeline] = None) -> bytes:
         """``read_buffers`` joined into one bytes object (padding
         included; the file reader trims)."""
         return b"".join(
-            await self.read_buffers(cx, coder, backend, batcher, cache))
+            await self.read_buffers(cx, coder, backend, batcher, cache,
+                                    pipeline))
 
     async def read_buffers(self, cx: Optional[LocationContext] = None,
                            coder: Optional[ErasureCoder] = None,
                            backend: Optional[str] = None,
                            batcher: Optional[ReconstructBatcher] = None,
-                           cache: Optional[ChunkCache] = None) -> list:
+                           cache: Optional[ChunkCache] = None,
+                           pipeline: Optional[HostPipeline] = None) -> list:
         """Scattered read: d workers randomly grab chunks from the shared
         d+p pool, falling through each chunk's locations; RS-reconstruct if
         any data chunk is missing.  Returns the d data-chunk buffers in
@@ -234,6 +264,11 @@ class FilePart:
         digest share a single fetch), and whole verified buffers —
         never trimmed ranges — are what gets inserted."""
         cx = cx or default_context()
+        pipe = _pipe(pipeline)
+        if cx.profiler is not None:
+            # read-side verification runs on the host pipeline, so its
+            # per-stage busy/idle/bytes counters belong in the report
+            cx.profiler.attach_pipeline(pipe)
         if cache is not None and cx.profiler is not None:
             # a cache hit produces no read log entry at all, so the
             # profiler surfaces the cache's own counters instead
@@ -268,7 +303,17 @@ class FilePart:
                         return None  # unmappable: generic path below
                     return (chunk.hash.verify(data), data)
 
-                fused = await asyncio.to_thread(mapped_and_verified)
+                # Deliberate tradeoff: chunks at or under the pipeline's
+                # inline bound (128 KiB) map+verify ON the event loop —
+                # a cold page costs a bounded small-read stall (~µs on
+                # SSD, ms-scale worst case), but lockstep completion is
+                # what lets concurrent degraded parts coalesce their
+                # reconstruct dispatches (the thread hop both costs more
+                # than the hash AND staggers arrivals).  Large chunks
+                # always hop to the workers.
+                fused = await pipe.run(
+                    "verify", mapped_and_verified,
+                    nbytes=location.range.length or self.chunksize)
                 if fused is not None:
                     return fused
                 # the mapper's None is deterministic — go straight to
@@ -276,7 +321,10 @@ class FilePart:
                 data = await location.read(cx)
             else:
                 data = await _read_chunk_payload(location, cx)
-            return (await chunk.hash.verify_async(data), data)
+            ok = await pipe.run(
+                "verify", lambda data=data: chunk.hash.verify(data),
+                nbytes=_buf_len(data))
+            return (ok, data)
 
         async def fetch_chunk(chunk: Chunk) -> Optional[object]:
             """First verified buffer across the chunk's locations, or
@@ -368,6 +416,7 @@ class FilePart:
         data_buf: BufferLike,
         length: int,
         precomputed: Optional[tuple] = None,
+        pipeline: Optional[HostPipeline] = None,
     ) -> "FilePart":
         """Encode one part and write all d+p shards concurrently,
         failing fast on the first shard error.
@@ -376,15 +425,17 @@ class FilePart:
         ``(shards, parity, buf_length, digests)`` from a staging layer;
         ``digests`` (32-byte sha256 per shard, data then parity — the
         fused encode+hash output) skips re-hashing here."""
+        pipe = _pipe(pipeline)
         digests: Optional[list] = None
         if precomputed is not None:
             shards, parity, buf_length = precomputed[:3]
             if len(precomputed) > 3:
                 digests = precomputed[3]
         else:
-            shards, parity, buf_length = await asyncio.to_thread(
-                FilePart.encode_shards, coder, data_buf, length
-            )
+            shards, parity, buf_length = await pipe.run(
+                "encode",
+                lambda: FilePart.encode_shards(coder, data_buf, length),
+                nbytes=length)
         d, p = coder.data, coder.parity
         if digests is not None and len(digests) != d + p:
             raise FileWriteError(
@@ -404,7 +455,10 @@ class FilePart:
             if digest is not None:
                 hash_ = AnyHash.sha256(Sha256Hash(digest))
             else:
-                hash_ = await hash_buf_async(payload)
+                hash_ = await pipe.run(
+                    "hash",
+                    lambda payload=payload: AnyHash.from_buf(payload),
+                    nbytes=_buf_len(payload))
             try:
                 locations = await writer.write_shard(hash_, payload)
             except ShardError as err:
@@ -431,22 +485,28 @@ class FilePart:
     #: (every location of every chunk at once, file_part.rs:228-251)
     VERIFY_READ_CONCURRENCY = 10
 
-    async def verify(self, cx: Optional[LocationContext] = None
+    async def verify(self, cx: Optional[LocationContext] = None,
+                     pipeline: Optional[HostPipeline] = None
                      ) -> "VerifyPartReport":
         cx = cx or default_context()
+        pipe = _pipe(pipeline)
+        if cx.profiler is not None:
+            cx.profiler.attach_pipeline(pipe)
         sem = asyncio.Semaphore(self.VERIFY_READ_CONCURRENCY)
 
         async def check(ci: int, chunk: Chunk, li: int,
                         location: Location) -> tuple:
             async with sem:
-                digest = await _hash_local_fused(chunk, location, cx)
+                digest = await _hash_local_fused(chunk, location, cx, pipe)
                 if digest is not None:
                     return (ci, li, digest == chunk.hash.value.digest, None)
                 try:
                     data = await location.read(cx)
                 except LocationError as err:
                     return (ci, li, None, str(err))
-                ok = await chunk.hash.verify_async(data)
+                ok = await pipe.run(
+                    "verify", lambda data=data: chunk.hash.verify(data),
+                    nbytes=_buf_len(data))
                 return (ci, li, ok, None)
 
         jobs = [
@@ -464,7 +524,8 @@ class FilePart:
                        cx: Optional[LocationContext] = None,
                        coder: Optional[ErasureCoder] = None,
                        backend: Optional[str] = None,
-                       batcher: Optional[ReconstructBatcher] = None
+                       batcher: Optional[ReconstructBatcher] = None,
+                       pipeline: Optional[HostPipeline] = None
                        ) -> "ResilverPartReport":
         # Deviation from the reference: repair writes always overwrite.
         # Under the default `on_conflict: ignore` tunable the reference's
@@ -476,6 +537,9 @@ class FilePart:
         if overwrite is not None:
             destination = overwrite()
         cx = cx or destination.get_context()
+        pipe = _pipe(pipeline)
+        if cx.profiler is not None:
+            cx.profiler.attach_pipeline(pipe)
         chunks = self.all_chunks()
         d, p = len(self.data), len(self.parity)
 
@@ -488,7 +552,10 @@ class FilePart:
                 except LocationError as err:
                     report.append((None, str(err)))
                     continue
-                ok = await chunk.hash.verify_async(data)
+                ok = await pipe.run(
+                    "verify",
+                    lambda chunk=chunk, data=data: chunk.hash.verify(data),
+                    nbytes=_buf_len(data))
                 if ok and chunk_bytes is None:
                     chunk_bytes = data
                 report.append((ok, None))

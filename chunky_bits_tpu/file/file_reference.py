@@ -89,16 +89,23 @@ class FileReference:
 
     # ---- verify / resilver fan-out (file_reference.rs:78-113) ----
 
-    async def verify(self, cx: Optional[LocationContext] = None
-                     ) -> "VerifyFileReport":
+    async def verify(self, cx: Optional[LocationContext] = None,
+                     pipeline=None) -> "VerifyFileReport":
         # Bounded parts-in-flight, like resilver.  The reference gathers
         # every part at once (file_reference.rs:78-87) — unbounded sockets
         # on a 10 GiB file; bounding is a deliberate improvement.
+        from chunky_bits_tpu.parallel.host_pipeline import get_host_pipeline
+
         sem = asyncio.Semaphore(RESILVER_CONCURRENCY)
+        # ONE host pipeline across the whole file: the ~10x10 in-flight
+        # location reads funnel their SHA-256 re-hash through its
+        # min(N, nproc) workers instead of one thread (multi-core
+        # verify), and the report's profiler sees one set of counters
+        pipe = pipeline if pipeline is not None else get_host_pipeline()
 
         async def one(part: FilePart) -> "VerifyPartReport":
             async with sem:
-                return await part.verify(cx)
+                return await part.verify(cx, pipeline=pipe)
 
         reports = await aio.gather_or_cancel(
             [one(p) for p in self.parts])
@@ -106,19 +113,23 @@ class FileReference:
 
     async def resilver(self, destination,
                        cx: Optional[LocationContext] = None,
-                       backend: Optional[str] = None
-                       ) -> "ResilverFileReport":
+                       backend: Optional[str] = None,
+                       pipeline=None) -> "ResilverFileReport":
         from chunky_bits_tpu.ops.batching import ReconstructBatcher
+        from chunky_bits_tpu.parallel.host_pipeline import get_host_pipeline
 
         sem = asyncio.Semaphore(RESILVER_CONCURRENCY)
         # All in-flight parts share one batcher: parts degraded by the same
         # node loss share an erasure pattern and rebuild in one dispatch.
         batcher = ReconstructBatcher(backend=backend)
+        # ...and one host pipeline: shard re-hash during the re-read
+        # phase runs sliced across its workers (see verify above)
+        pipe = pipeline if pipeline is not None else get_host_pipeline()
 
         async def one(part: FilePart) -> ResilverPartReport:
             async with sem:
                 return await part.resilver(destination, cx, backend=backend,
-                                           batcher=batcher)
+                                           batcher=batcher, pipeline=pipe)
 
         try:
             # on failure siblings are cancelled before the drain below, so

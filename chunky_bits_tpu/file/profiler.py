@@ -39,6 +39,7 @@ class Profiler:
         self._entries: list[ResultLog] = []
         self._lock = threading.Lock()
         self._caches: list = []  # read caches whose counters we surface
+        self._pipelines: list = []  # host pipelines ditto
 
     def attach_cache(self, cache) -> None:
         """Register a chunk cache so its hit/miss/eviction/singleflight
@@ -53,6 +54,21 @@ class Profiler:
         """Snapshot of each attached cache's counters (CacheStats)."""
         with self._lock:
             return [c.stats() for c in self._caches]
+
+    def attach_pipeline(self, pipeline) -> None:
+        """Register a host pipeline (parallel/host_pipeline.py) so its
+        per-stage busy/idle/bytes counters ride along in the report —
+        hashing and encode run on its workers, not at the I/O hooks, so
+        saturation would otherwise be invisible here."""
+        with self._lock:
+            if all(p is not pipeline for p in self._pipelines):
+                self._pipelines.append(pipeline)
+
+    def pipeline_stats(self) -> list:
+        """Snapshot of each attached pipeline's counters
+        (PipelineStats)."""
+        with self._lock:
+            return [p.stats() for p in self._pipelines]
 
     def log_read(self, ok: bool, error: Optional[str], location,
                  length: int, start_time: float) -> None:
@@ -75,9 +91,11 @@ class Profiler:
 
 
 class ProfileReport:
-    def __init__(self, entries: list[ResultLog], cache_stats: list = ()):
+    def __init__(self, entries: list[ResultLog], cache_stats: list = (),
+                 pipeline_stats: list = ()):
         self.entries = entries
         self.cache_stats = list(cache_stats)
+        self.pipeline_stats = list(pipeline_stats)
 
     def _avg(self, kind: str) -> Optional[float]:
         durations = [e.duration for e in self.entries if e.kind == kind]
@@ -110,6 +128,8 @@ class ProfileReport:
         )
         for stats in self.cache_stats:
             base += f" {stats}"
+        for stats in self.pipeline_stats:
+            base += f" {stats}"
         return base
 
 
@@ -121,7 +141,8 @@ class ProfileReporter:
 
     def profile(self) -> ProfileReport:
         return ProfileReport(self._profiler.drain(),
-                             self._profiler.cache_stats())
+                             self._profiler.cache_stats(),
+                             self._profiler.pipeline_stats())
 
 
 def new_profiler() -> tuple[Profiler, ProfileReporter]:

@@ -38,6 +38,11 @@ class FileReadBuilder:
     #: skip fetch + verify, and whole verified chunks are what's cached
     #: even under seek/take (trimming happens here, at the edge)
     cache: Optional[object] = None
+    #: host compute executor for read-side hash verification
+    #: (parallel/host_pipeline.HostPipeline); None = the process-shared
+    #: pipeline — the cluster serve path injects its own when
+    #: ``tunables.host_threads`` pins a count
+    pipeline: Optional[object] = None
 
     def with_backend(self, backend: Optional[str]) -> "FileReadBuilder":
         return replace(self, backend=backend)
@@ -47,6 +52,9 @@ class FileReadBuilder:
 
     def with_cache(self, cache) -> "FileReadBuilder":
         return replace(self, cache=cache)
+
+    def with_pipeline(self, pipeline) -> "FileReadBuilder":
+        return replace(self, pipeline=pipeline)
 
     def with_seek(self, seek: int) -> "FileReadBuilder":
         return replace(self, seek=seek)
@@ -150,7 +158,8 @@ class FileReadBuilder:
         # only when reconstruction is actually needed
         buffers = await part.read_buffers(self.cx, backend=self.backend,
                                           batcher=batcher,
-                                          cache=self.cache)
+                                          cache=self.cache,
+                                          pipeline=self.pipeline)
         if not skip:
             return buffers
         out = []

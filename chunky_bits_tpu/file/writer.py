@@ -29,6 +29,13 @@ of the [stage_size, d, chunk] staging block (``aio.read_exact_into``,
 zero-copy for ``readinto``-capable readers), so full-length parts reach
 the encoder already in batched device layout with no intermediate bytes
 objects or restaging memcpy; only the short tail part is repacked.
+
+Multi-core host plane: each staged sub-block's encode+hash runs through
+the shared host pipeline (parallel/host_pipeline.py) — per-stripe fused
+encode+hash sliced across ``min(N, nproc)`` daemon workers — so the
+socket/page-cache read loop overlaps compute on every scheduler core.
+Ordered part assembly and the placement stagger are untouched: slices
+write positionally into the staged batch's outputs.
 """
 
 from __future__ import annotations
@@ -66,6 +73,11 @@ class FileWriteBuilder:
     #: (coalesces many small files into one device dispatch), or a zero-arg
     #: callable resolving to one inside the running loop, or None.
     encode_batcher: object = None
+    #: a parallel.host_pipeline.HostPipeline running this write's host
+    #: compute (per-stripe encode + per-shard SHA sliced across daemon
+    #: workers), or None for the process-shared one.  The scaling sweeps
+    #: (bench --config 2 --sweep-threads) inject per-N instances here.
+    host_pipeline: object = None
 
     # builder setters (writer.rs:78-110); return copies like the Rust
     # builder's consume-and-return
@@ -101,6 +113,9 @@ class FileWriteBuilder:
     def with_encode_batcher(self, encode_batcher) -> "FileWriteBuilder":
         return replace(self, encode_batcher=encode_batcher)
 
+    def with_host_pipeline(self, host_pipeline) -> "FileWriteBuilder":
+        return replace(self, host_pipeline=host_pipeline)
+
     async def write(self, reader: aio.AsyncByteReader) -> FileReference:
         if self.concurrency <= 1:
             raise FileWriteError("concurrency must be > 1")
@@ -110,6 +125,13 @@ class FileWriteBuilder:
         coder = get_coder(d, p, self.backend)
         from chunky_bits_tpu.file.collection_destination import \
             as_destination
+        from chunky_bits_tpu.parallel.host_pipeline import get_host_pipeline
+
+        # the multi-core host plane: per-stripe encode + per-shard SHA
+        # run sliced across the pipeline's daemon workers, so the read
+        # loop (socket/page-cache) overlaps compute on every core the
+        # scheduler was given, not just one
+        pipeline = self.host_pipeline or get_host_pipeline()
 
         destination = as_destination(self.destination)
 
@@ -132,7 +154,8 @@ class FileWriteBuilder:
 
             encode_batcher = EncodeHashBatcher(
                 backend=self.backend,
-                max_batch=max(1, batch_parts // stage_size))
+                max_batch=max(1, batch_parts // stage_size),
+                host_pipeline=pipeline)
             own_batcher = True
 
         # Read-ahead bound: by default at most two sub-blocks of raw parts
@@ -204,7 +227,8 @@ class FileWriteBuilder:
             With a shared encode batcher, the dispatch additionally
             coalesces with other concurrent writes (many-small-files /
             gateway ingest)."""
-            groups = await asyncio.to_thread(stage, blk, ls)
+            groups = await pipeline.run(
+                "stage", lambda: stage(blk, ls), nbytes=sum(ls))
             results: dict[int, tuple[list, list, int, Optional[list]]] = {}
 
             async def encode_group(shard_len, indices, stacked):
@@ -216,8 +240,8 @@ class FileWriteBuilder:
                     parity_batch, digest_batch = \
                         await encode_batcher.encode_hash(d, p, stacked)
                 else:
-                    parity_batch, digest_batch = await asyncio.to_thread(
-                        coder.encode_hash_batch, stacked)
+                    parity_batch, digest_batch = \
+                        await pipeline.encode_hash(coder, stacked)
                 for bi, i in enumerate(indices):
                     results[i] = (
                         list(stacked[bi]),

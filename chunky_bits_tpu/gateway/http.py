@@ -131,6 +131,15 @@ def make_app(cluster: Cluster,
     put_sem = (asyncio.Semaphore(max_concurrent_puts)
                if max_concurrent_puts > 0 else contextlib.nullcontext())
 
+    # PUT ingest compute (per-shard SHA-256 + per-stripe GF encode) runs
+    # on the cluster's host pipeline workers, so the event loop's socket
+    # receive overlaps encode+hash on every scheduler core instead of
+    # sharing one thread with it.  Resolve (and thereby spawn) the
+    # workers now: the first request shouldn't pay the warm-up, and a
+    # misconfigured tunables.host_threads should fail at serve start,
+    # not mid-ingest.
+    cluster.host_pipeline()
+
     async def handle_get(request: web.Request) -> web.StreamResponse:
         path = request.match_info["path"]
         try:

@@ -23,10 +23,7 @@ from __future__ import annotations
 import hashlib
 import threading
 from abc import ABC, abstractmethod
-from typing import TYPE_CHECKING, Callable, Optional, Sequence
-
-if TYPE_CHECKING:  # typing-only; the pool import is deferred at runtime
-    from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 
@@ -206,43 +203,26 @@ def get_backend(name: Optional[str] = None) -> ErasureBackend:
     return backend
 
 
-def _hash_rows_hashlib(rows: np.ndarray, out: np.ndarray) -> None:
-    """out[b, j] = sha256(rows[b, j]) for uint8 rows[B, n, S]."""
-    for i in range(rows.shape[0]):
-        for j in range(rows.shape[1]):
-            out[i, j] = np.frombuffer(
-                hashlib.sha256(rows[i, j]).digest(), dtype=np.uint8)
+def _hash_rows_hashlib(rows: np.ndarray, out: np.ndarray,
+                       nthreads: int = 0) -> None:
+    """out[..., 32] = sha256 of each row of uint8 rows[..., S].
+    ``nthreads`` is accepted for signature parity with the native engine
+    and ignored — hashlib runs row-at-a-time under the GIL here; callers
+    wanting parallelism slice rows across the host pipeline's workers."""
+    for idx in np.ndindex(rows.shape[:-1]):
+        out[idx] = np.frombuffer(
+            hashlib.sha256(np.ascontiguousarray(rows[idx])).digest(),
+            dtype=np.uint8)
 
 
 _ROW_HASHER = None
-_INGEST_POOL = None
-_INGEST_POOL_LOCK = threading.Lock()
 
 
-def _ingest_hash_pool() -> "ThreadPoolExecutor":
-    """Small shared thread pool for overlapping host-side SHA-256 with
-    asynchronous device dispatch (jax/mesh backends).  Two workers: one
-    for the data rows, one draining parity blocks as they land; the
-    native hashing engine releases the GIL, so both run truly parallel
-    to the dispatching thread."""
-    global _INGEST_POOL
-    if _INGEST_POOL is None:
-        with _INGEST_POOL_LOCK:
-            if _INGEST_POOL is None:
-                from concurrent.futures import ThreadPoolExecutor
-
-                # lint: thread-ok workers run host-side SHA only (GIL
-                # -free native calls) and never enter PJRT, so the
-                # futures atexit join cannot park on the device
-                _INGEST_POOL = ThreadPoolExecutor(
-                    max_workers=2, thread_name_prefix="cb-ingest-hash")
-    return _INGEST_POOL
-
-
-def _row_hasher() -> Callable[[np.ndarray, np.ndarray], None]:
-    """Bulk shard hasher for non-native parity backends (e.g. jax): the
-    native SHA-NI engine hashing all rows in one threaded GIL-free call,
-    or a hashlib loop when the C++ library can't build."""
+def row_hasher() -> Callable[..., None]:
+    """Bulk shard-row hasher ``fn(rows[..., S], out[..., 32],
+    nthreads=0)``: the native SHA-NI engine when it builds (GIL-free;
+    ``nthreads`` caps its internal fan-out — the host pipeline passes 1
+    per slice), else a hashlib loop computing identical digests."""
     global _ROW_HASHER
     if _ROW_HASHER is None:
         try:
@@ -317,19 +297,28 @@ class ErasureCoder:
             return fused(self.parity_rows, np.ascontiguousarray(data))
         data = np.ascontiguousarray(data)
         b, _, _ = data.shape
-        hash_rows = _row_hasher()
+        hash_rows = row_hasher()
         data_digests = np.empty((b, self.data, 32), dtype=np.uint8)
         if getattr(self.backend, "async_dispatch", False):
-            # device backends (mesh): hash the data rows on the host
-            # while the device computes parity
-            fut = _ingest_hash_pool().submit(hash_rows, data, data_digests)
+            # device backends (mesh): hash the data rows on the shared
+            # host pipeline's daemon workers (sliced across them) while
+            # the device computes parity — the same overlap the retired
+            # 2-worker ThreadPoolExecutor provided, now on the bounded
+            # CB103-clean executor every host path shares
+            from chunky_bits_tpu.parallel.host_pipeline import (
+                get_host_pipeline,
+                join_jobs,
+            )
+
+            jobs = get_host_pipeline().hash_rows_jobs(data, data_digests)
             parity = self.encode_batch(data)
-            fut.result()
+            join_jobs(jobs)
         else:
             parity = self.encode_batch(data)
             hash_rows(data, data_digests)
         if not self.parity:
             return parity, data_digests
+        parity = np.ascontiguousarray(parity)
         parity_digests = np.empty((b, self.parity, 32), dtype=np.uint8)
         hash_rows(parity, parity_digests)
         return parity, np.concatenate([data_digests, parity_digests], axis=1)

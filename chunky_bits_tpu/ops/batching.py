@@ -207,7 +207,19 @@ class EncodeHashBatcher(_CoalescingBatcher):
     unmerged.  The cluster wires a shared instance only for device
     backends — CPU writes already amortize per-part overhead through the
     writer's zero-copy staging.
+
+    ``host_pipeline`` (a parallel.host_pipeline.HostPipeline) routes each
+    dispatch's host compute through the shared multi-core executor —
+    per-stripe fused encode+hash sliced across its workers — instead of
+    one ``coder.encode_hash_batch`` call; None keeps the direct call
+    (whose device-backend path already overlaps hashing on the shared
+    pipeline internally).
     """
+
+    def __init__(self, backend: Optional[str] = None, max_batch: int = 128,
+                 host_pipeline: Optional[object] = None):
+        super().__init__(backend, max_batch)
+        self.host_pipeline = host_pipeline
 
     async def encode_hash(
         self, d: int, p: int, stacked: np.ndarray
@@ -230,6 +242,8 @@ class EncodeHashBatcher(_CoalescingBatcher):
         (possibly merged) ``[B, d, S]`` batch.  The merge policy, dispatch
         counting, and slice-back in ``_run_group`` are shared — variants
         (e.g. bench.py's hash-free pipeline probe) override only this."""
+        if self.host_pipeline is not None:
+            return self.host_pipeline.encode_hash_sync(coder, stacked)
         return coder.encode_hash_batch(stacked)
 
     def _run_group(self, key: tuple, batches: list[np.ndarray]) -> list:

@@ -151,9 +151,13 @@ def sha256_file(path: str, start: int = 0,
     return out.raw
 
 
-def sha256_rows(rows: np.ndarray, out: np.ndarray) -> None:
+def sha256_rows(rows: np.ndarray, out: np.ndarray,
+                nthreads: int = 0) -> None:
     """out[..., 32] = sha256 of each row of uint8 rows[..., S], hashed by
-    the native engine in one threaded, GIL-free call."""
+    the native engine in one threaded, GIL-free call.  ``nthreads``
+    bounds the internal std::thread fan-out (0 = hardware concurrency);
+    the host pipeline passes 1 per slice so total parallelism stays the
+    scheduler's worker count, not workers x cores."""
     lib = _load()
     n = int(np.prod(rows.shape[:-1]))
     if n == 0 or rows.shape[-1] == 0:
@@ -165,7 +169,7 @@ def sha256_rows(rows: np.ndarray, out: np.ndarray) -> None:
         raise ErasureError("sha256_rows needs a contiguous output")
     lib.cb_sha256_rows(
         rows.ctypes.data_as(ctypes.c_char_p), n, rows.shape[-1],
-        out.ctypes.data_as(ctypes.c_void_p), 0,
+        out.ctypes.data_as(ctypes.c_void_p), int(nthreads),
     )
 
 
@@ -204,19 +208,40 @@ class NativeBackend(ErasureBackend):
         r = mat.shape[0]
         parity = np.zeros((b, r, s), dtype=np.uint8)
         hashes = np.zeros((b, k + r, 32), dtype=np.uint8)
+        return self.encode_and_hash_into(mat, shards, parity, hashes,
+                                         self.nthreads)
+
+    def encode_and_hash_into(
+        self, mat: np.ndarray, shards: np.ndarray,
+        out_parity: np.ndarray, out_hashes: np.ndarray,
+        nthreads: Optional[int] = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """``encode_and_hash`` writing into caller-provided contiguous
+        ``out_parity[b, r, s]`` / ``out_hashes[b, k+r, 32]`` slices — the
+        host pipeline's zero-copy sliced entry point: each scheduler
+        worker encodes+hashes its contiguous stripe range with
+        ``nthreads=1`` directly into its rows of the shared outputs, so
+        assembling the batch result is positional, not a copy."""
+        b, k, s = shards.shape
+        r = mat.shape[0]
         if b == 0 or s == 0:
             # zero-length shards still hash: digest must be sha256(b""),
             # matching the generic fallback (ops/backend.py)
             if b and s == 0:
-                hashes[:, :] = np.frombuffer(
+                out_hashes[:, :] = np.frombuffer(
                     hashlib.sha256(b"").digest(), dtype=np.uint8)
-            return parity, hashes
+            return out_parity, out_hashes
+        if not (out_parity.flags.c_contiguous
+                and out_hashes.flags.c_contiguous):
+            raise ErasureError("encode_and_hash_into needs contiguous "
+                               "outputs")
         mat = np.ascontiguousarray(mat, dtype=np.uint8)
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
         self._lib.cb_encode_hash(
             mat.ctypes.data_as(ctypes.c_char_p), r, k,
             shards.ctypes.data_as(ctypes.c_char_p), b, s,
-            parity.ctypes.data_as(ctypes.c_void_p),
-            hashes.ctypes.data_as(ctypes.c_void_p), self.nthreads,
+            out_parity.ctypes.data_as(ctypes.c_void_p),
+            out_hashes.ctypes.data_as(ctypes.c_void_p),
+            self.nthreads if nthreads is None else int(nthreads),
         )
-        return parity, hashes
+        return out_parity, out_hashes

@@ -506,13 +506,12 @@ class JaxBackend(ErasureBackend):
         on the pallas path) the digests are computed ON the device in
         the same dispatch as the parity — the host's per-core SHA bound
         drops out of the pipeline entirely."""
-        from chunky_bits_tpu.ops.backend import _ingest_hash_pool, \
-            _row_hasher
+        from chunky_bits_tpu.ops.backend import row_hasher
 
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
         b, k, s = shards.shape
         r = mat.shape[0]
-        hash_rows = _row_hasher()
+        hash_rows = row_hasher()
         data_digests = np.empty((b, k, 32), dtype=np.uint8)
         parity_digests = np.empty((b, r, 32), dtype=np.uint8)
         if b == 0 or s == 0 or r == 0:
@@ -553,21 +552,29 @@ class JaxBackend(ErasureBackend):
                 self._device_sha_ok = False
                 warnings.warn(
                     f"device SHA path disabled after failure: {err}")
-        pool = _ingest_hash_pool()
-        futs = [pool.submit(hash_rows, shards, data_digests)]
+        # host hashing overlaps the in-flight device dispatch on the
+        # shared host pipeline's daemon workers (sliced across them),
+        # the same overlap the retired 2-worker ThreadPoolExecutor
+        # provided — CB103-clean and observable in the stage counters
+        from chunky_bits_tpu.parallel.host_pipeline import (
+            get_host_pipeline,
+            join_jobs,
+        )
+
+        pipe = get_host_pipeline()
+        jobs = list(pipe.hash_rows_jobs(shards, data_digests))
         covered = np.zeros(b, dtype=bool)
 
         def on_block(lo, arr):
             # axis-0 slices of the C-contiguous digest array are
             # contiguous, so the hasher can write in place
             covered[lo:lo + arr.shape[0]] = True
-            futs.append(pool.submit(
-                hash_rows, arr, parity_digests[lo:lo + arr.shape[0]]))
+            jobs.extend(pipe.hash_rows_jobs(
+                arr, parity_digests[lo:lo + arr.shape[0]]))
 
         was_on_tpu = self._on_tpu
         parity = self.apply_matrix(mat, shards, on_block=on_block)
-        for f in futs:
-            f.result()
+        join_jobs(jobs)
         if was_on_tpu and not self._on_tpu:
             # A mid-run pallas failure fell back to einsum: the RETURNED
             # parity is the einsum recomputation, but digests hashed from

@@ -1,16 +1,50 @@
-"""Multi-chip scaling: mesh-sharded erasure transforms."""
+"""Host- and device-plane parallelism.
 
-from chunky_bits_tpu.parallel.mesh import (  # noqa: F401
-    encode_step_sharded,
-    encode_wide_sharded,
-    make_mesh,
-    make_stripe_mesh,
-    sharded_apply,
-    wide_apply_sharded,
+Two planes live here: the jax mesh-sharded erasure transforms
+(``mesh``/``multihost``/``backend`` — multi-chip scaling) and the
+CPU-only host compute pipeline (``host_pipeline`` — multi-core ingest
+hashing + encode).  The package exports are resolved lazily (PEP 562)
+so importing the host plane never pays the seconds-long jax import the
+mesh modules need: ``from chunky_bits_tpu.parallel import
+get_host_pipeline`` stays cheap on CPU-only CLI paths.
+"""
+
+from typing import Any
+
+_MESH_EXPORTS = (
+    "encode_step_sharded",
+    "encode_wide_sharded",
+    "make_mesh",
+    "make_stripe_mesh",
+    "sharded_apply",
+    "wide_apply_sharded",
 )
-from chunky_bits_tpu.parallel.multihost import (  # noqa: F401
-    init_multihost,
-    local_mesh,
-    local_stripe_mesh,
-    partition_parts,
+_MULTIHOST_EXPORTS = (
+    "init_multihost",
+    "local_mesh",
+    "local_stripe_mesh",
+    "partition_parts",
 )
+_HOST_PIPELINE_EXPORTS = (
+    "HostPipeline",
+    "get_host_pipeline",
+)
+
+__all__ = list(_MESH_EXPORTS + _MULTIHOST_EXPORTS + _HOST_PIPELINE_EXPORTS)
+
+
+def __getattr__(name: str) -> Any:
+    if name in _MESH_EXPORTS:
+        from chunky_bits_tpu.parallel import mesh
+
+        return getattr(mesh, name)
+    if name in _MULTIHOST_EXPORTS:
+        from chunky_bits_tpu.parallel import multihost
+
+        return getattr(multihost, name)
+    if name in _HOST_PIPELINE_EXPORTS:
+        from chunky_bits_tpu.parallel import host_pipeline
+
+        return getattr(host_pipeline, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -96,9 +96,9 @@ if xor3 > 0 and plain > 0 and fusedm > 0:
           f"({(fusedm / plain - 1) * 100:.0f}%)", flush=True)
 
 # --- 3. host engine on the same rows --------------------------------
-from chunky_bits_tpu.ops.backend import _row_hasher
+from chunky_bits_tpu.ops.backend import row_hasher
 
-hash_rows = _row_hasher()
+hash_rows = row_hasher()
 flat = data.reshape(batch * d, size)
 out = np.empty((flat.shape[0], 32), dtype=np.uint8)
 hash_rows(flat.reshape(batch, d, size),

@@ -404,11 +404,11 @@ def test_verify_fanout_is_bounded(tmp_path, monkeypatch):
         real_verify = FilePart.verify
         real_read = Location.read
 
-        async def counting_verify(self, cx=None):
+        async def counting_verify(self, cx=None, **kwargs):
             in_flight["parts"] += 1
             peaks["parts"] = max(peaks["parts"], in_flight["parts"])
             try:
-                return await real_verify(self, cx)
+                return await real_verify(self, cx, **kwargs)
             finally:
                 in_flight["parts"] -= 1
 
@@ -428,7 +428,7 @@ def test_verify_fanout_is_bounded(tmp_path, monkeypatch):
         # would bypass Location.read and leave the read cap untested
         import chunky_bits_tpu.file.file_part as fp_mod
 
-        async def no_fused(chunk, location, cx):
+        async def no_fused(chunk, location, cx, pipeline=None):
             return None
 
         monkeypatch.setattr(fp_mod, "_hash_local_fused", no_fused)
