@@ -1010,6 +1010,15 @@ def bench_small_objects(argv=()) -> None:
 
 
 if __name__ == "__main__":
+    # Bench measures the product defaults: the runtime concurrency
+    # sanitizer (analysis/sanitizer.py) must stay OFF here even when an
+    # inherited $CHUNKY_BITS_TPU_SANITIZE would turn it on — its
+    # instrumentation is a correctness tool whose overhead would
+    # pollute every recorded number (write, not read: the one
+    # sanctioned env handoff, like the CLI's backend write).
+    import os as _os
+
+    _os.environ["CHUNKY_BITS_TPU_SANITIZE"] = "0"
     # Default (no args): BASELINE config 2/3 on the device — the driver's
     # recorded metric.  --config 1|4 run the auxiliary BASELINE.md configs.
     if "--config" in sys.argv:

@@ -23,6 +23,27 @@ carries the full story):
 - strict typing on the public compute/serve surfaces  -> CB106
   ``annotations``
 
+CB2xx — concurrency hazards of the two-plane host/async runtime
+(concurrency.py; ``--select CB2`` runs the family alone):
+
+- the event loop must never execute blocking sync I/O -> CB201
+  ``async-blocking``
+- threading locks must not be held across awaits      -> CB202
+  ``lock-across-await``
+- every spawned task needs an owner                   -> CB203
+  ``task-leak``
+- worker code re-enters the loop only through the
+  _threadsafe doors (call-graph pass, callgraph.py)   -> CB204
+  ``cross-plane``
+- serve-path singletons are per-event-loop            -> CB205
+  ``loop-shared``
+
+The runtime side of the same contract lives in ``sanitizer.py``: an
+opt-in (``$CHUNKY_BITS_TPU_SANITIZE``) loop-stall watchdog, task-leak
+registry, and HostPipeline handoff checker.  It is deliberately NOT
+imported here — the off path must never load instrumentation (and this
+package must keep importing clean on a bare interpreter).
+
 Entry points: ``python -m chunky_bits_tpu.analysis`` and
 ``scripts/check.sh`` (tier-1 and CI both run the latter).  Violations
 are suppressed inline with ``# lint: <slug>-ok <reason>`` (the reason is

@@ -19,7 +19,7 @@ from chunky_bits_tpu.analysis.core import (
     run_analysis,
     write_baseline,
 )
-from chunky_bits_tpu.analysis.rules import ALL_RULES
+from chunky_bits_tpu.analysis.rules import ALL_RULES, rule_family
 
 PACKAGE_ROOT = Path(__file__).resolve().parents[1]
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.toml"
@@ -49,7 +49,8 @@ def main(argv: list[str] | None = None) -> int:
         help="accept all current findings into the baseline and exit 0")
     parser.add_argument(
         "--select", default="",
-        help="comma-separated rule ids to run (e.g. CB101,CB104)")
+        help="comma-separated rule ids or family prefixes to run "
+             "(e.g. CB101,CB104 — or CB2 for the whole CB2xx family)")
     parser.add_argument("--json", action="store_true",
                         help="emit one JSON object instead of text")
     parser.add_argument("--list-rules", action="store_true")
@@ -57,15 +58,34 @@ def main(argv: list[str] | None = None) -> int:
 
     rules = ALL_RULES
     if args.select:
-        wanted = {r.strip().upper() for r in args.select.split(",")}
-        unknown = wanted - {r.id for r in ALL_RULES}
+        # empty tokens (trailing/doubled commas) would prefix-match
+        # every rule and silently widen the scan — drop them, and
+        # error when nothing real remains
+        wanted = {r.strip().upper() for r in args.select.split(",")
+                  if r.strip()}
+        if not wanted:
+            parser.error("--select given but no rule ids in it")
+        # a token selects every rule id it prefixes, so CB2 selects the
+        # whole CB2xx family and CB101 selects exactly itself
+        unknown = {w for w in wanted
+                   if not any(r.id.startswith(w) for r in ALL_RULES)}
         if unknown:
-            parser.error(f"unknown rule ids: {', '.join(sorted(unknown))}")
-        rules = tuple(r for r in ALL_RULES if r.id in wanted)
+            parser.error(
+                f"unknown rule ids: {', '.join(sorted(unknown))}")
+        rules = tuple(r for r in ALL_RULES
+                      if any(r.id.startswith(w) for w in wanted))
 
     if args.list_rules:
+        from chunky_bits_tpu.analysis.rules import FAMILY_HAZARDS
+
+        families: dict[str, list] = {}
         for rule in rules:
-            print(f"{rule.id}  {rule.slug:16s} {rule.description}")
+            families.setdefault(rule.family, []).append(rule)
+        for family in sorted(families):
+            hazard = FAMILY_HAZARDS.get(family, "")
+            print(f"{family} — {hazard}" if hazard else family)
+            for rule in families[family]:
+                print(f"  {rule.id}  {rule.slug:18s} {rule.description}")
         return 0
 
     files = None
@@ -112,7 +132,8 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.json:
         print(json.dumps({
-            "new": [v.__dict__ for v in new],
+            "new": [{**v.__dict__, "rule_family": rule_family(v.rule)}
+                    for v in new],
             "baselined": len(matched),
             "stale_baseline_entries": stale,
             "errors": errors,

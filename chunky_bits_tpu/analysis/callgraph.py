@@ -1,0 +1,252 @@
+"""Module-granular call graph for the CB2xx concurrency rules.
+
+The CB204 cross-plane rule needs an answer to "can this function run on
+a HostPipeline worker thread?" — a *reachability* question, so this
+module builds the first interprocedural pass in ``analysis/``.  It is
+deliberately module-granular and name-based (pure stdlib ``ast``, no
+imports resolved, no types inferred):
+
+* **Nodes** are every ``def`` / ``async def`` / ``lambda`` in the
+  scanned files, keyed ``(rel, qualname)`` where qualname is the dotted
+  class/function nesting path (lambdas get ``<lambda>@line:col``).
+* **Edges** resolve by name within one module: ``f(...)`` links to any
+  same-module function whose last qualname segment is ``f``;
+  ``self.m(...)`` / ``cls.m(...)`` links to any same-module *method*
+  named ``m`` (override-coarse on purpose: a base-class dispatch must
+  reach every same-named override the module defines).
+* **Roots** are the places code hops OFF the event loop onto a plain
+  thread: ``threading.Thread(target=...)``, ``asyncio.to_thread(f,
+  ...)``, ``loop.run_in_executor(None, f, ...)``, job callables handed
+  to the host pipeline (``_Job(stage, fn)``, ``.submit(stage, fn)``,
+  and ``.run(stage, fn)`` with a string stage — the async entry point
+  the product read/write paths use), ``add_done_callback`` callbacks
+  (they run on
+  whichever thread finishes the job), and ``HostPipeline._worker``
+  itself.  Callables passed to ``call_soon_threadsafe`` /
+  ``run_coroutine_threadsafe`` are explicitly NOT roots — that pair is
+  the sanctioned way back onto the loop.
+
+Over-approximation (same-name collisions, overrides) errs toward
+flagging, which the shared ``# lint: <slug>-ok <reason>`` machinery can
+excuse; under-approximation (dynamic dispatch through stored callables,
+e.g. ``job.fn()``) is exactly why the roots include every callable the
+tree hands to a worker at the submit site.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator, Optional
+
+#: the sanctioned loop re-entry points: callables passed to these are
+#: back ON the loop, so they are never worker roots
+THREADSAFE_WRAPPERS = ("call_soon_threadsafe", "run_coroutine_threadsafe")
+
+#: method names that are always worker bodies regardless of how they
+#: are reached (the scheduler's own run loop)
+ALWAYS_ROOT_METHODS = ("_worker",)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def attr_chain(node: ast.AST) -> str:
+    """Dotted name for Name/Attribute chains ('loop.call_soon'), or ''."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+@dataclass
+class FuncInfo:
+    """One function/method/lambda node in the graph."""
+
+    rel: str
+    qualname: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    cls: Optional[str]  # lexically enclosing class, if any
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.rel, self.qualname)
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+
+def iter_body_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's OWN statements: descend the body but stop at
+    nested def/lambda boundaries (those are separate graph nodes —
+    their code runs when *they* are called, not when the outer function
+    does)."""
+    stack = list(ast.iter_child_nodes(fn))
+    # the function's own args/defaults evaluate in the caller, skip the
+    # nested bodies only
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _FUNC_NODES):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class CallGraph:
+    """Name-resolved call graph over a set of parsed files."""
+
+    def __init__(self) -> None:
+        self.functions: dict[tuple[str, str], FuncInfo] = {}
+        #: key -> set of callee keys
+        self.edges: dict[tuple[str, str], set[tuple[str, str]]] = {}
+        self.roots: set[tuple[str, str]] = set()
+        #: per (rel, last-name-segment) function lookup for resolution
+        self._by_name: dict[tuple[str, str], list[FuncInfo]] = {}
+
+    # ---- construction ----
+
+    def _add_function(self, info: FuncInfo) -> None:
+        self.functions[info.key] = info
+        self.edges.setdefault(info.key, set())
+        self._by_name.setdefault((info.rel, info.name), []).append(info)
+
+    def _collect_functions(self, rel: str, tree: ast.AST) -> dict:
+        """Register every function in ``tree``; returns node -> FuncInfo
+        so the edge pass can map callables back to graph nodes."""
+        node_map: dict[ast.AST, FuncInfo] = {}
+
+        def visit(node: ast.AST, quals: tuple[str, ...],
+                  cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, quals + (child.name,), child.name)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    q = ".".join(quals + (child.name,))
+                    info = FuncInfo(rel, q, child, cls)
+                    self._add_function(info)
+                    node_map[child] = info
+                    # nested defs/lambdas belong to no class: calling
+                    # self.x() inside them still resolves class-wide
+                    visit(child, quals + (child.name,), cls)
+                elif isinstance(child, ast.Lambda):
+                    q = ".".join(
+                        quals + (f"<lambda>@{child.lineno}:"
+                                 f"{child.col_offset}",))
+                    info = FuncInfo(rel, q, child, cls)
+                    self._add_function(info)
+                    node_map[child] = info
+                    visit(child, quals, cls)
+                else:
+                    visit(child, quals, cls)
+
+        visit(tree, (), None)
+        return node_map
+
+    def _resolve_callable(self, rel: str, expr: ast.AST,
+                          node_map: dict) -> list[FuncInfo]:
+        """Graph nodes a callable expression may denote: a lambda is
+        itself; a name/attribute resolves by last segment within the
+        module (methods and functions alike)."""
+        if isinstance(expr, ast.Lambda):
+            info = node_map.get(expr)
+            return [info] if info is not None else []
+        chain = attr_chain(expr)
+        if not chain:
+            return []
+        return list(self._by_name.get((rel, chain.rsplit(".", 1)[-1]),
+                                      []))
+
+    def _call_roots(self, rel: str, call: ast.Call,
+                    node_map: dict) -> Iterator[FuncInfo]:
+        """Worker-root callables referenced by one Call node."""
+        func = call.func
+        chain = attr_chain(func)
+        tail = chain.rsplit(".", 1)[-1] if chain else ""
+        candidates: list[ast.AST] = []
+        if tail == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    candidates.append(kw.value)
+        elif tail == "to_thread" and call.args:
+            candidates.append(call.args[0])
+        elif tail == "run_in_executor" and len(call.args) >= 2:
+            candidates.append(call.args[1])
+        elif tail == "_Job" and len(call.args) >= 2:
+            candidates.append(call.args[1])
+        elif (tail in ("submit", "run") and len(call.args) >= 2
+                and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)):
+            # HostPipeline.submit(stage, fn) / await pipeline.run(stage,
+            # fn) — the string stage distinguishes them from
+            # concurrent.futures submit(fn, ...) and asyncio.run(coro)
+            candidates.append(call.args[1])
+        elif tail == "add_done_callback" and call.args:
+            # completion callbacks run on whichever thread finishes the
+            # job — for pipeline jobs that is a worker
+            candidates.append(call.args[0])
+        for expr in candidates:
+            yield from self._resolve_callable(rel, expr, node_map)
+
+    def add_module(self, rel: str, tree: ast.AST) -> None:
+        node_map = self._collect_functions(rel, tree)
+        # edges + roots: scan each function's own body, remembering
+        # which Call nodes live inside functions so the module-level
+        # pass below visits only the remainder
+        in_function: set[int] = set()
+        for info in [i for i in self.functions.values() if i.rel == rel]:
+            for node in iter_body_nodes(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                in_function.add(id(node))
+                for root in self._call_roots(rel, node, node_map):
+                    self.roots.add(root.key)
+                func = node.func
+                if isinstance(func, ast.Name):
+                    for callee in self._by_name.get(
+                            (rel, func.id), []):
+                        self.edges[info.key].add(callee.key)
+                elif isinstance(func, ast.Attribute):
+                    base = attr_chain(func.value)
+                    if base in ("self", "cls"):
+                        for callee in self._by_name.get(
+                                (rel, func.attr), []):
+                            if callee.cls is not None:
+                                self.edges[info.key].add(callee.key)
+        # module-level code (import-time Thread spawns etc.) can also
+        # hand out roots
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and id(node) not in in_function:
+                for root in self._call_roots(rel, node, node_map):
+                    self.roots.add(root.key)
+        for info in self.functions.values():
+            if info.rel == rel and info.cls is not None \
+                    and info.name in ALWAYS_ROOT_METHODS:
+                self.roots.add(info.key)
+
+    # ---- queries ----
+
+    def worker_reachable(self) -> set[tuple[str, str]]:
+        """Keys of every function reachable from a worker root."""
+        seen: set[tuple[str, str]] = set()
+        stack = list(self.roots)
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            stack.extend(self.edges.get(key, ()))
+        return seen
+
+
+def build_call_graph(files: Iterable) -> CallGraph:
+    """Graph over ``SourceFile``s (anything with ``.rel`` + ``.tree``)."""
+    graph = CallGraph()
+    for sf in files:
+        graph.add_module(sf.rel, sf.tree)
+    return graph

@@ -137,12 +137,19 @@ def run_analysis(root: Path, rules: Iterable[object],
     """Run ``rules`` over every ``*.py`` under ``root`` (or the explicit
     ``files``).  Returns ``(violations, errors)`` — a file that fails to
     parse is an *error*, not a silent skip: the gate must not go green
-    because the tree stopped being parseable."""
+    because the tree stopped being parseable.
+
+    Two rule shapes: per-file rules implement ``check(sf)``; *project*
+    rules (``rule.project`` truthy, e.g. the CB204 cross-plane pass)
+    implement ``check_project(sfs)`` over every parsed file at once so
+    they can build a call graph before reporting.  Both feed the same
+    suppression, fingerprint, and baseline machinery."""
     root = root.resolve()
     violations: list[Violation] = []
     errors: list[str] = []
     paths = list(files) if files is not None else \
         list(iter_python_files(root))
+    sources: list[SourceFile] = []
     for path in paths:
         path = path.resolve()
         try:
@@ -157,18 +164,34 @@ def run_analysis(root: Path, rules: Iterable[object],
             continue
         try:
             text = path.read_text(encoding="utf-8")
-            sf = SourceFile(path, rel, text)
+            sources.append(SourceFile(path, rel, text))
         except (OSError, SyntaxError, ValueError) as err:
             errors.append(f"{rel}: unreadable/unparseable: {err}")
             continue
-        raw: list[tuple[object, int, int, str]] = []
-        for rule in rules:
-            if not rule.applies(rel):
+    by_rel = {sf.rel: sf for sf in sources}
+    # raw findings bucketed per file so fingerprint occurrence indices
+    # stay per-file regardless of which rule shape produced them
+    raw_by_rel: dict[str, list[tuple[object, int, int, str]]] = \
+        {sf.rel: [] for sf in sources}
+    per_file = [r for r in rules if not getattr(r, "project", False)]
+    project = [r for r in rules if getattr(r, "project", False)]
+    for sf in sources:
+        for rule in per_file:
+            if not rule.applies(sf.rel):
                 continue
             for line, col, message in rule.check(sf):
                 if sf.suppressed(rule.slug, line):
                     continue
-                raw.append((rule, line, col, message))
+                raw_by_rel[sf.rel].append((rule, line, col, message))
+    for rule in project:
+        scoped = [sf for sf in sources if rule.applies(sf.rel)]
+        for rel, line, col, message in rule.check_project(scoped):
+            sf = by_rel.get(rel)
+            if sf is None or sf.suppressed(rule.slug, line):
+                continue
+            raw_by_rel[rel].append((rule, line, col, message))
+    for sf in sources:
+        raw = raw_by_rel[sf.rel]
         # occurrence index among same (rule, snippet) pairs, in line
         # order, keeps fingerprints stable under unrelated edits
         raw.sort(key=lambda item: (item[1], item[2]))
@@ -178,9 +201,9 @@ def run_analysis(root: Path, rules: Iterable[object],
             occ = seen.get((rule.id, snippet), 0)
             seen[(rule.id, snippet)] = occ + 1
             violations.append(Violation(
-                rule=rule.id, slug=rule.slug, path=rel, line=line,
+                rule=rule.id, slug=rule.slug, path=sf.rel, line=line,
                 col=col, message=message, snippet=snippet,
-                fingerprint=_fingerprint(rule.id, rel, snippet, occ)))
+                fingerprint=_fingerprint(rule.id, sf.rel, snippet, occ)))
     return violations, errors
 
 

@@ -35,12 +35,28 @@ STRICT_TYPED_MODULES = (
 Finding = tuple[int, int, str]
 
 
+def rule_family(rule_id: str) -> str:
+    """Family id for a rule id ('CB101' -> 'CB1xx') — the ONE place the
+    derivation lives; ``Rule.family`` and the CLI's --json
+    ``rule_family`` field both come through here."""
+    return f"{rule_id[:3]}xx"
+
+
 class Rule:
     id: str = ""
     slug: str = ""
     description: str = ""
     #: rel-path prefixes the rule applies to; () = every file
     paths: tuple[str, ...] = ()
+    #: project rules see every parsed file at once via
+    #: ``check_project(sfs)`` (interprocedural passes — see core.py)
+    project: bool = False
+
+    @property
+    def family(self) -> str:
+        """Rule family id, 'CB1xx' / 'CB2xx' (the --select prefix and
+        the --json ``rule_family`` field)."""
+        return rule_family(self.id)
 
     def applies(self, rel: str) -> bool:
         if not self.paths:
@@ -376,6 +392,21 @@ class PublicAnnotationsRule(Rule):
                                             is_method=not is_static)
 
 
+#: one-line hazard descriptions for --list-rules family grouping
+FAMILY_HAZARDS = {
+    "CB1xx": ("single-function invariants: bounded waits, env-flag "
+              "discipline, daemon threads, narrow excepts, jit "
+              "hygiene, typing floor"),
+    "CB2xx": ("concurrency hazards of the two-plane host/async "
+              "runtime: blocked loops, cross-plane handoffs, leaked "
+              "tasks, loop-spanning shared state"),
+}
+
+# imported at the bottom: concurrency.py needs Rule defined first
+from chunky_bits_tpu.analysis.concurrency import (  # noqa: E402
+    CONCURRENCY_RULES,
+)
+
 ALL_RULES: tuple[Rule, ...] = (
     UnboundedAwaitRule(),
     EnvFlagDisciplineRule(),
@@ -383,4 +414,4 @@ ALL_RULES: tuple[Rule, ...] = (
     BroadExceptRule(),
     JitBodyHygieneRule(),
     PublicAnnotationsRule(),
-)
+) + CONCURRENCY_RULES

@@ -97,7 +97,9 @@ class FileOrDirectory:
         top = await FileOrDirectory.from_local_path(path)
         out = [top]
         if top.is_directory():
-            names = await asyncio.to_thread(sorted, os.listdir(path))
+            # the listdir itself must ride the thread hop: as an eager
+            # argument it would run on the loop (CB201)
+            names = sorted(await asyncio.to_thread(os.listdir, path))
             for name in names:
                 child = os.path.join(path, name)
                 try:

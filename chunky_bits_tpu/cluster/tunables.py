@@ -45,6 +45,15 @@ HOST_THREADS_ENV = "CHUNKY_BITS_TPU_HOST_THREADS"
 #: default resolution in ops/backend.get_backend reads it
 BACKEND_ENV = "CHUNKY_BITS_TPU_BACKEND"
 
+#: opt-in runtime concurrency sanitizer (analysis/sanitizer.py):
+#: event-loop stall watchdog, task-leak registry, host-pipeline handoff
+#: checks.  Off by default (and force-disabled by bench.py — the
+#: sanitizer is a correctness tool, not a perf mode); read at the
+#: activation points (HostPipeline construction, gateway serve,
+#: tests/conftest session start), so set it before the process builds
+#: its first pipeline or loop.
+SANITIZE_ENV = "CHUNKY_BITS_TPU_SANITIZE"
+
 
 # ---- environment accessors (the ONE home for CHUNKY_BITS_TPU_* reads) ----
 #
@@ -110,6 +119,15 @@ def host_threads(*, default: int = 0) -> int:
     except ValueError:
         return default
     return v if v > 0 else default
+
+
+def sanitize_enabled() -> bool:
+    """True when ``$CHUNKY_BITS_TPU_SANITIZE`` asks for the runtime
+    concurrency sanitizer.  Callers gate BOTH the instrumentation and
+    the ``analysis.sanitizer`` import on this, so the off path never
+    even loads the instrumentation module (pinned by
+    tests/test_sanitizer.py's zero-overhead check)."""
+    return env_flag(SANITIZE_ENV)
 
 
 def _default_host_threads() -> int:

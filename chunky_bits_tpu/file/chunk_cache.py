@@ -77,6 +77,13 @@ class ChunkCache:
     """Bounded byte-budget LRU of verified chunk buffers, digest-keyed,
     with singleflight fetch deduplication."""
 
+    #: all bookkeeping runs lock-free on the owning loop's thread (the
+    #: "single event loop" invariant above); the CB204 cross-plane rule
+    #: reads this tag and flags any call into the cache from
+    #: HostPipeline-worker-reachable code that isn't routed through
+    #: call_soon_threadsafe/run_coroutine_threadsafe
+    LOOP_BOUND = True
+
     def __init__(self, capacity_bytes: int) -> None:
         if capacity_bytes <= 0:
             raise ValueError("capacity_bytes must be positive")

@@ -226,38 +226,66 @@ async def _publish_atomically(target: str, write_body) -> int:
     existing regular file's permission bits carry over to the
     replacement; ownership becomes the writing process's and hard links
     detach — correct for content-addressed chunks, where an in-place
-    rewrite would mutate every linked path."""
-    if os.path.islink(target) or (
-            os.path.exists(target) and not os.path.isfile(target)):
+    rewrite would mutate every linked path.
+
+    The pre-check rides a thread hop (CB201: on a network filesystem
+    its stat/exists syscalls are round trips, and this runs per chunk
+    on the gateway PUT path; the temp file does not exist yet, so the
+    hop opens no cleanup race).  The chmod+replace swap and the
+    error-path temp reaping deliberately stay sync: a suspension point
+    between the completed write and the rename would let a cancellation
+    interleave the reap with an in-flight swap (unlink-vs-replace race,
+    or a publish the caller observed as cancelled), and both are
+    bounded local metadata syscalls on a just-created staging file."""
+    direct, mode = await asyncio.to_thread(_publish_precheck, target)
+    if direct:
         return await write_body(target)
-    mode = None
-    try:
-        mode = os.stat(target).st_mode & 0o7777
-    except OSError:
-        pass
     tmp = publish_temp_name(target)
     try:
         total = await write_body(tmp)
         if mode is not None:
+            # lint: async-blocking-ok bounded local chmod on the
+            # staging file; sync keeps publication atomic under
+            # cancellation (see docstring)
             os.chmod(tmp, mode)
+        # lint: async-blocking-ok bounded local rename; a suspension
+        # here would let a cancellation race the reap against the
+        # in-flight swap (see docstring)
         os.replace(tmp, target)
         return total
     except OSError as err:
-        created = os.path.exists(tmp)
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
+        created = _reap_publish_temp(tmp)
         if not created and err.errno in (errno.EACCES, errno.EPERM,
                                          errno.EROFS):
             return await write_body(target)
         raise
     except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
+        _reap_publish_temp(tmp)
         raise
+
+
+def _publish_precheck(target: str) -> tuple[bool, Optional[int]]:
+    """(write-direct?, preserved mode) for one publication — the sync
+    half of _publish_atomically's target inspection, batched into a
+    single executor hop."""
+    if os.path.islink(target) or (
+            os.path.exists(target) and not os.path.isfile(target)):
+        return True, None
+    try:
+        return False, os.stat(target).st_mode & 0o7777
+    except OSError:
+        return False, None
+
+
+def _reap_publish_temp(tmp: str) -> bool:
+    """Remove a staging temp; True when it existed (i.e. write_body got
+    far enough to create it — the EACCES-fallback discriminator)."""
+    created = os.path.exists(tmp)
+    try:
+        os.unlink(tmp)
+    except OSError:
+        pass
+    return created
 
 
 async def _atomic_publish(target: str, data) -> None:

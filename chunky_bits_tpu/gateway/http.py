@@ -271,6 +271,16 @@ async def serve(cluster: Cluster, host: str = "127.0.0.1",
                 ) -> None:
     """Bind and serve until cancelled (ctrl-c graceful shutdown,
     main.rs:474-485)."""
+    from chunky_bits_tpu.cluster.tunables import sanitize_enabled
+
+    if sanitize_enabled():
+        # opt-in runtime concurrency sanitizer: instrument the serving
+        # loop (stall watchdog + task registry) — read here, at the one
+        # moment the gateway's loop is known, like every other
+        # first-use tunable
+        from chunky_bits_tpu.analysis.sanitizer import get_monitor
+
+        get_monitor().instrument_loop(asyncio.get_running_loop())
     runner = web.AppRunner(
         make_app(cluster, max_put_bytes=max_put_bytes,
                  max_concurrent_puts=max_concurrent_puts,

@@ -47,6 +47,14 @@ class _CoalescingBatcher:
     in a worker thread).
     """
 
+    #: ``_pending``/``_task``/``_inflight`` bookkeeping is lock-free
+    #: because it never leaves the owning loop's thread; the CB204
+    #: cross-plane rule reads this tag and flags calls into a batcher
+    #: from HostPipeline-worker-reachable code that skip the
+    #: call_soon_threadsafe/run_coroutine_threadsafe doors (subclasses
+    #: inherit the tag by base-name resolution)
+    LOOP_BOUND = True
+
     def __init__(self, backend: Optional[str] = None, max_batch: int = 128):
         self.backend = backend
         self.max_batch = max_batch

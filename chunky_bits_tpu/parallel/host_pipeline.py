@@ -52,6 +52,7 @@ from __future__ import annotations
 import asyncio
 import os
 import queue
+import sys
 import threading
 import time
 from dataclasses import dataclass
@@ -65,6 +66,15 @@ from chunky_bits_tpu.errors import ErasureError
 #: that shutdown is prompt, long enough to stay off the scheduler's hot
 #: path (a parked worker wakes on the put, not the timeout)
 _POLL_SECONDS = 0.5
+
+
+def _sanitizer():
+    """The active runtime concurrency sanitizer, or None.  Reached only
+    through ``sys.modules`` so the sanitize-off path costs one dict
+    lookup and never imports the instrumentation (the zero-overhead
+    contract pinned by tests/test_sanitizer.py)."""
+    mod = sys.modules.get("chunky_bits_tpu.analysis.sanitizer")
+    return mod.active() if mod is not None else None
 
 
 class _Job:
@@ -117,6 +127,14 @@ class _Job:
         """Wait for completion without raising.  The poll keeps the wait
         interruptible at interpreter shutdown; jobs always finish — the
         runner records result-or-error in a ``finally``."""
+        if not self._event.is_set():
+            # a blocking wait issued FROM a loop thread stalls every
+            # request on that loop; the sanitizer records it (an
+            # already-finished job — the inline small-job path — never
+            # waits, so it is exempt by the is_set() guard)
+            san = _sanitizer()
+            if san is not None:
+                san.handoff.check_sync_wait("_Job.join()")
         while not self._event.wait(_POLL_SECONDS):
             pass
 
@@ -217,6 +235,15 @@ class HostPipeline:
         self._stages: dict[str, list] = {}  # stage -> [jobs, busy_s, bytes]
         self._idle_s = 0.0
         self._local = threading.local()
+        # self-activate the runtime sanitizer when the flag asks for it
+        # (read-at-first-use like host_threads); when off, nothing is
+        # imported and no per-job instrumentation exists
+        from chunky_bits_tpu.cluster.tunables import sanitize_enabled
+
+        if sanitize_enabled():
+            from chunky_bits_tpu.analysis.sanitizer import get_monitor
+
+            get_monitor()
         self._workers = [
             threading.Thread(target=self._worker, daemon=True,
                              name=f"{name}-{i}")
@@ -315,9 +342,15 @@ class HostPipeline:
             return job.wait()
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
+        san = _sanitizer()
+        token = san.handoff.submit_token() if san is not None else None
 
         def bridge(j: _Job) -> None:
             def resolve() -> None:
+                if token is not None and san is not None:
+                    # the handoff contract: this completion must be
+                    # delivered on the submitting loop's thread
+                    san.handoff.check_resolve(token)
                 if fut.cancelled():
                     return
                 if j.error is not None:

@@ -5,7 +5,11 @@
 #   1. the project-native invariant linter (chunky_bits_tpu/analysis):
 #      pure stdlib AST rules, NO jax/numpy/aiohttp import, so it runs
 #      even when the device tunnel is down and on bare runners.  Always
-#      BLOCKING.
+#      BLOCKING.  Covers both families: CB1xx single-function
+#      invariants and the CB2xx concurrency-hazard rules (blocking
+#      calls in async defs, locks across awaits, leaked tasks, the
+#      cross-plane call-graph pass, loop-shared state); run one family
+#      alone with `python -m chunky_bits_tpu.analysis --select CB2`.
 #   2. mypy over the strict-typed surfaces ([tool.mypy] in
 #      pyproject.toml) — only when mypy is installed, and ADVISORY by
 #      default (MYPY_STRICT=1 makes it blocking).  The dev image cannot

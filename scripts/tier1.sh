@@ -9,6 +9,14 @@
 #   - a DOTS_PASSED count parsed from the progress lines, and the
 #     pytest exit code as the script's own.
 # Log lands in /tmp/_t1.log for postmortems.
+#
+# Sanitize leg: CHUNKY_BITS_TPU_SANITIZE=1 bash scripts/tier1.sh runs
+# the identical suite under the runtime concurrency sanitizer
+# (chunky_bits_tpu/analysis/sanitizer.py) — tests/conftest.py installs
+# it before any event loop exists and fails the session on leaked
+# tasks, swallowed task exceptions, or cross-plane handoff violations
+# (loop stalls are reported but advisory).  CI runs this as its own
+# matrix entry.
 set -o pipefail
 cd "$(dirname "$0")/.."
 

@@ -28,3 +28,34 @@ except ImportError:
     pass
 else:
     jax.config.update("jax_platforms", "cpu")
+
+# Runtime concurrency sanitizer (opt-in, the tier-1 sanitize leg:
+# CHUNKY_BITS_TPU_SANITIZE=1 bash scripts/tier1.sh).  Installed here —
+# before any test creates an event loop — so every loop the suite spins
+# up is instrumented; pytest_sessionfinish below turns leaked tasks /
+# swallowed task exceptions / handoff violations into a session
+# failure, extending the leak-strict gate to the async plane.  Loop
+# stalls are reported but advisory (shared CI boxes stall under load).
+from chunky_bits_tpu.cluster.tunables import sanitize_enabled  # noqa: E402
+
+_SANITIZER = None
+if sanitize_enabled():
+    from chunky_bits_tpu.analysis import sanitizer as _sanitizer_mod
+
+    _SANITIZER = _sanitizer_mod.install()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _SANITIZER is None:
+        return
+    report = _SANITIZER.report()
+    print()  # keep the report off pytest's progress line
+    print(report.render())
+    if not report.ok():
+        print("sanitizer: FAILING the session (leaked tasks / "
+              "unretrieved exceptions / handoff violations above)")
+        # only upgrade a green session: an interrupted/errored run
+        # (exitstatus 2/3) tears loops down mid-test and would always
+        # "leak" — overwriting would hide the real signal
+        if exitstatus == 0:
+            session.exitstatus = 1

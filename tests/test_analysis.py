@@ -292,6 +292,327 @@ def test_private_and_nonstrict_modules_pass(tmp_path):
     """, select=("CB106",)) == []
 
 
+# ---- CB201 async-blocking ----
+
+def test_async_blocking_flags_sleep_open_subprocess(tmp_path):
+    vs = run_snippet(tmp_path, "gateway/x.py", """
+        import subprocess
+        import time
+
+        async def handler(path):
+            time.sleep(1.0)
+            with open(path) as f:
+                data = f.read()
+            subprocess.run(["sync"])
+            return data
+    """, select=("CB201",))
+    assert [v.rule for v in vs] == ["CB201"] * 3
+    assert "time.sleep" in vs[0].message
+    assert "open" in vs[1].message
+    assert "subprocess.run" in vs[2].message
+
+
+def test_async_blocking_flags_eager_args_of_to_thread(tmp_path):
+    # os.listdir(path) as an ARGUMENT runs on the loop before the hop
+    vs = run_snippet(tmp_path, "cluster/x.py", """
+        import asyncio
+        import os
+
+        async def ls(path):
+            return await asyncio.to_thread(sorted, os.listdir(path))
+    """, select=("CB201",))
+    assert [v.rule for v in vs] == ["CB201"]
+    assert "os.listdir" in vs[0].message
+
+
+def test_async_blocking_passes_offloaded_and_nested_sync(tmp_path):
+    vs = run_snippet(tmp_path, "file/x.py", """
+        import asyncio
+        import os
+
+        async def ok(path):
+            # callable passed, not called: runs on the worker
+            f = await asyncio.to_thread(open, path, "rb")
+            names = await asyncio.to_thread(os.listdir, path)
+            return f, names
+
+        async def nested(path, data):
+            def _write():
+                with open(path, "wb") as f:
+                    f.write(data)
+            await asyncio.to_thread(_write)
+
+        def sync_code(path):
+            return open(path).read()
+    """, select=("CB201",))
+    assert vs == []
+
+
+# ---- CB202 lock-across-await ----
+
+def test_lock_across_await_flagged(tmp_path):
+    vs = run_snippet(tmp_path, "parallel/x.py", """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def bad(self, fetch):
+                with self._lock:
+                    return await fetch()
+    """, select=("CB202",))
+    assert [v.rule for v in vs] == ["CB202"]
+    assert "_lock" in vs[0].message
+
+
+def test_lock_across_await_resolves_bare_import(tmp_path):
+    vs = run_snippet(tmp_path, "parallel/x.py", """
+        from threading import Lock
+
+        guard = Lock()
+
+        async def bad(fetch):
+            with guard:
+                return await fetch()
+    """, select=("CB202",))
+    assert [v.rule for v in vs] == ["CB202"]
+
+
+def test_lock_across_await_flags_implicit_suspensions(tmp_path):
+    """async for / async with suspend without an ast.Await node; the
+    lock is held across the suspension all the same."""
+    vs = run_snippet(tmp_path, "file/x.py", """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def bad_for(self, stream):
+                with self._lock:
+                    async for chunk in stream:
+                        self.total += len(chunk)
+
+            async def bad_with(self, resource):
+                with self._lock:
+                    async with resource:
+                        return self.total
+    """, select=("CB202",))
+    assert [v.rule for v in vs] == ["CB202", "CB202"]
+
+
+def test_lock_across_await_passes_safe_shapes(tmp_path):
+    vs = run_snippet(tmp_path, "parallel/x.py", """
+        import asyncio
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._alock = asyncio.Lock()
+
+            async def ok(self, fetch):
+                with self._lock:
+                    snapshot = self.x  # sync-only critical section
+                return await fetch(snapshot)
+
+            async def ok_async_lock(self, fetch):
+                async with self._alock:
+                    return await fetch()
+
+            async def ok_nested_def(self, fetch):
+                with self._lock:
+                    async def later():
+                        await fetch()  # runs after release
+                    return later
+    """, select=("CB202",))
+    assert vs == []
+
+
+# ---- CB203 task-leak ----
+
+def test_fire_and_forget_task_flagged(tmp_path):
+    vs = run_snippet(tmp_path, "gateway/x.py", """
+        import asyncio
+
+        async def spawny(work, loop):
+            asyncio.create_task(work())
+            asyncio.ensure_future(work())
+            loop.create_task(work())
+    """, select=("CB203",))
+    assert [v.rule for v in vs] == ["CB203"] * 3
+
+
+def test_stored_awaited_and_callbacked_tasks_pass(tmp_path):
+    vs = run_snippet(tmp_path, "gateway/x.py", """
+        import asyncio
+
+        async def ok(work, registry):
+            t = asyncio.create_task(work())
+            registry.append(asyncio.ensure_future(work()))
+            await asyncio.create_task(work())
+            asyncio.create_task(work()).add_done_callback(print)
+            return t
+    """, select=("CB203",))
+    assert vs == []
+
+
+# ---- CB204 cross-plane (the call-graph pass) ----
+
+def test_cross_plane_flags_event_set_via_thread_target(tmp_path):
+    vs = run_snippet(tmp_path, "parallel/x.py", """
+        import asyncio
+        import threading
+
+        class Pipe:
+            def __init__(self):
+                self.done = asyncio.Event()
+                self._t = threading.Thread(
+                    target=self._worker_body, daemon=True)
+
+            def _worker_body(self):
+                self.finish()
+
+            def finish(self):
+                self.done.set()
+    """, select=("CB204",))
+    assert [v.rule for v in vs] == ["CB204"]
+    assert "asyncio.Event" in vs[0].message and "finish" in vs[0].message
+
+
+def test_cross_plane_flags_loop_bound_class_via_job_lambda(tmp_path):
+    # lambda handed to _Job + LOOP_BOUND tag inheritance by base name
+    vs = run_snippet(tmp_path, "parallel/x.py", """
+        class Batcher:
+            LOOP_BOUND = True
+
+            def poke(self):
+                pass
+
+        class SubBatcher(Batcher):
+            pass
+
+        def stage(pipe, data):
+            b = SubBatcher()
+            pipe.submit("encode", lambda: b.poke())
+    """, select=("CB204",))
+    assert [v.rule for v in vs] == ["CB204"]
+    assert "LOOP_BOUND" in vs[0].message
+
+
+def test_cross_plane_flags_callable_via_pipeline_run(tmp_path):
+    """The async product path hands compute to workers through
+    ``await pipeline.run(stage, fn)`` — those callables are roots too."""
+    vs = run_snippet(tmp_path, "file/x.py", """
+        import asyncio
+
+        class Cache:
+            LOOP_BOUND = True
+
+            def get(self, key):
+                return None
+
+        async def serve(pipe, key):
+            cache = Cache()
+            return await pipe.run("verify", lambda: cache.get(key))
+    """, select=("CB204",))
+    assert [v.rule for v in vs] == ["CB204"]
+    assert "cache.get" in vs[0].message
+
+
+def test_cross_plane_flags_call_soon_from_decorated_to_thread_target(
+        tmp_path):
+    vs = run_snippet(tmp_path, "ops/x.py", """
+        import asyncio
+        import functools
+
+        @functools.lru_cache(None)
+        def hop(loop, fn):
+            loop.call_soon(fn)
+
+        async def go(loop, fn):
+            await asyncio.to_thread(hop, loop, fn)
+    """, select=("CB204",))
+    assert [v.rule for v in vs] == ["CB204"]
+    assert "call_soon" in vs[0].message
+
+
+def test_cross_plane_passes_threadsafe_doors_and_thread_event(tmp_path):
+    vs = run_snippet(tmp_path, "parallel/x.py", """
+        import asyncio
+        import threading
+
+        class Pipe:
+            def __init__(self):
+                self._done = threading.Event()
+                self._t = threading.Thread(
+                    target=self._worker_body, daemon=True)
+
+            def _worker_body(self):
+                self._done.set()  # threading.Event: thread-safe
+
+            def bridge(self, loop, fn, coro):
+                loop.call_soon_threadsafe(fn)
+                asyncio.run_coroutine_threadsafe(coro(), loop)
+
+        def make(pipe):
+            job = pipe.submit("hash", lambda: 1)
+            job.add_done_callback(pipe.bridge)
+    """, select=("CB204",))
+    assert vs == []
+
+
+def test_cross_plane_ignores_unreachable_loop_code(tmp_path):
+    # the same loop-bound touches OFF the worker graph are fine
+    vs = run_snippet(tmp_path, "parallel/x.py", """
+        import asyncio
+
+        class Pipe:
+            def __init__(self):
+                self.done = asyncio.Event()
+
+            async def on_loop(self):
+                self.done.set()
+    """, select=("CB204",))
+    assert vs == []
+
+
+# ---- CB205 loop-shared ----
+
+def test_loop_shared_flags_module_and_class_mutables(tmp_path):
+    vs = run_snippet(tmp_path, "gateway/x.py", """
+        import asyncio
+        from collections import OrderedDict
+
+        _registry = {}
+        _queue = asyncio.Queue()
+
+        class Handler:
+            seen = OrderedDict()
+    """, select=("CB205",))
+    assert [v.rule for v in vs] == ["CB205"] * 3
+    assert "dict literal" in vs[0].message
+    assert "loop-bound" in vs[1].message
+    assert "class-level" in vs[2].message
+
+
+def test_loop_shared_passes_safe_and_out_of_scope(tmp_path):
+    assert run_snippet(tmp_path, "parallel/x.py", """
+        import threading
+
+        _LOCK = threading.Lock()
+        _NAMES = ("a", "b")
+        __all__ = ["x"]
+        # lint: loop-shared-ok process-wide singleton guarded by _LOCK
+        _cache = {}
+    """, select=("CB205",)) == []
+    # ops/ and cluster/ are out of scope for CB205
+    assert run_snippet(tmp_path, "ops/x.py", """
+        _REGISTRY = {}
+    """, select=("CB205",)) == []
+
+
 # ---- suppression parsing ----
 
 def test_suppression_same_line_and_line_above(tmp_path):
@@ -490,11 +811,37 @@ def test_cli_write_baseline_refuses_scan_with_file_errors(tmp_path):
     assert not (tmp_path / "b.toml").exists()
 
 
-def test_cli_list_rules_names_all_six():
+def test_cli_list_rules_names_every_rule_grouped_by_family():
     proc = _run_cli("--list-rules")
     assert proc.returncode == 0
-    for rid in ("CB101", "CB102", "CB103", "CB104", "CB105", "CB106"):
+    for rid in ("CB101", "CB102", "CB103", "CB104", "CB105", "CB106",
+                "CB201", "CB202", "CB203", "CB204", "CB205"):
         assert rid in proc.stdout
+    # family grouping with one-line hazard descriptions
+    assert "CB1xx — " in proc.stdout
+    assert "CB2xx — " in proc.stdout
+    assert proc.stdout.index("CB1xx") < proc.stdout.index("CB101")
+    assert proc.stdout.index("CB2xx") < proc.stdout.index("CB201")
+
+
+def test_cli_select_family_prefix():
+    """--select CB2 selects the whole CB2xx family (the acceptance
+    criterion invocation), and exits 0 on the shipped tree."""
+    proc = _run_cli("--select", "CB2", "--list-rules")
+    assert proc.returncode == 0
+    assert "CB201" in proc.stdout and "CB205" in proc.stdout
+    assert "CB101" not in proc.stdout
+    proc = _run_cli("--select", "CB2")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = _run_cli("--select", "CB9")
+    assert proc.returncode == 2
+    assert "unknown rule ids" in proc.stderr
+    # empty tokens must not silently select every rule
+    proc = _run_cli("--select", "CB2,", "--list-rules")
+    assert proc.returncode == 0
+    assert "CB101" not in proc.stdout
+    proc = _run_cli("--select", ",")
+    assert proc.returncode == 2
 
 
 def test_cli_json_contract():
@@ -505,3 +852,19 @@ def test_cli_json_contract():
     payload = json.loads(proc.stdout)
     assert payload["ok"] is True
     assert payload["new"] == []
+
+
+def test_cli_json_reports_rule_family(tmp_path):
+    import json
+
+    scratch = tmp_path / "pkg"
+    (scratch / "gateway").mkdir(parents=True)
+    (scratch / "gateway" / "fresh.py").write_text(
+        "import asyncio\n\n\nasync def f(work):\n"
+        "    asyncio.create_task(work())\n", encoding="utf-8")
+    proc = _run_cli("--root", str(scratch), "--baseline",
+                    str(tmp_path / "empty.toml"), "--json")
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert [v["rule_family"] for v in payload["new"]] == ["CB2xx"]
+    assert payload["new"][0]["rule"] == "CB203"

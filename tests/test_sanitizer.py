@@ -1,0 +1,313 @@
+"""Runtime concurrency sanitizer (chunky_bits_tpu/analysis/sanitizer).
+
+Pins the three monitors' detection behavior (leaked tasks, swallowed
+task exceptions, loop stalls, handoff violations), the
+degrade-never-hang watchdog contract against dead loops, and the
+off-by-default zero-overhead contract: with the flag unset the
+instrumentation module is never even imported.
+
+Deliberate-violation end-to-end checks run in subprocesses: the global
+sanitizer is process-wide, and recording a violation in THIS process
+would fail the tier-1 sanitize leg's session report."""
+
+from __future__ import annotations
+
+import asyncio
+import gc
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from chunky_bits_tpu.analysis.sanitizer import (
+    HandoffChecker,
+    LoopWatchdog,
+    TaskRegistry,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _run_py(code: str, *, sanitize: str | None) -> \
+        subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env.pop("CHUNKY_BITS_TPU_SANITIZE", None)
+    if sanitize is not None:
+        env["CHUNKY_BITS_TPU_SANITIZE"] = sanitize
+    return subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, cwd=str(REPO), env=env)
+
+
+# ---- off-by-default: zero overhead ----
+
+def test_flag_unset_never_imports_instrumentation():
+    """The sanitize-off path must not even import the sanitizer module
+    — the whole cost is one sys.modules dict lookup per job wait."""
+    proc = _run_py("""
+import sys
+from chunky_bits_tpu.parallel.host_pipeline import HostPipeline
+
+pipe = HostPipeline(threads=2)
+jobs = [pipe.submit("t", lambda i=i: i * i) for i in range(8)]
+assert [j.wait() for j in jobs] == [i * i for i in range(8)]
+import asyncio
+
+
+async def body():
+    return await pipe.run("t", lambda: 41 + 1)
+
+
+assert asyncio.run(body()) == 42
+pipe.close()
+assert "chunky_bits_tpu.analysis.sanitizer" not in sys.modules, \\
+    "sanitizer imported with the flag unset"
+print("ZERO_OVERHEAD_OK")
+""", sanitize=None)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ZERO_OVERHEAD_OK" in proc.stdout
+
+
+def test_flag_set_activates_via_pipeline_construction():
+    proc = _run_py("""
+import sys
+from chunky_bits_tpu.parallel.host_pipeline import HostPipeline
+
+pipe = HostPipeline(threads=2)
+assert "chunky_bits_tpu.analysis.sanitizer" in sys.modules
+from chunky_bits_tpu.analysis import sanitizer
+
+assert sanitizer.active() is not None
+assert pipe.submit("t", lambda: 7).wait() == 7
+report = sanitizer.report()
+assert report.ok(), report.render()
+pipe.close()
+print("ACTIVATED_OK")
+""", sanitize="1")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ACTIVATED_OK" in proc.stdout
+
+
+# ---- task registry ----
+
+def test_leaked_task_detection_fires():
+    reg = TaskRegistry()
+    loop = asyncio.new_event_loop()
+    reg.install_on_loop(loop)
+
+    async def forever() -> None:
+        await asyncio.Event().wait()
+
+    async def spawn() -> asyncio.Task:
+        task = asyncio.get_running_loop().create_task(forever())
+        await asyncio.sleep(0)
+        return task
+
+    task = loop.run_until_complete(spawn())
+    try:
+        leaks = reg.pending_leaks()
+        assert len(leaks) == 1
+        # the creation site points at THIS file, not asyncio internals
+        assert "test_sanitizer" in leaks[0]
+    finally:
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            loop.run_until_complete(task)
+        loop.close()
+    assert reg.pending_leaks() == []
+
+
+def test_unretrieved_task_exception_captured():
+    reg = TaskRegistry()
+    loop = asyncio.new_event_loop()
+    reg.install_on_loop(loop)
+
+    async def boom() -> None:
+        raise RuntimeError("swallowed?")
+
+    async def spawn_and_drop() -> None:
+        asyncio.get_running_loop().create_task(boom())  # lint: task-leak-ok the leak IS the fixture
+        await asyncio.sleep(0.01)
+
+    loop.run_until_complete(spawn_and_drop())
+    loop.close()
+    gc.collect()
+    events = reg.events()
+    assert any("never retrieved" in e for e in events), events
+    assert any("swallowed?" in e for e in events), events
+
+
+def test_done_tasks_are_not_leaks():
+    reg = TaskRegistry()
+    loop = asyncio.new_event_loop()
+    reg.install_on_loop(loop)
+
+    async def work() -> int:
+        return 7
+
+    async def body() -> int:
+        return await asyncio.get_running_loop().create_task(work())
+
+    assert loop.run_until_complete(body()) == 7
+    loop.close()
+    assert reg.pending_leaks() == []
+    assert reg.events() == []
+
+
+# ---- watchdog ----
+
+@pytest.mark.filterwarnings("ignore")
+def test_watchdog_detects_blocked_loop():
+    wd = LoopWatchdog(threshold=0.1, interval=0.02)
+
+    def run() -> None:
+        loop = asyncio.new_event_loop()
+
+        async def body() -> None:
+            wd.watch(asyncio.get_running_loop())
+            await asyncio.sleep(0.1)  # let a heartbeat land
+            time.sleep(0.5)  # block the loop: the hazard
+            await asyncio.sleep(0.05)
+
+        loop.run_until_complete(body())
+        loop.close()
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    thread.join(timeout=10)
+    assert not thread.is_alive()
+    wd.stop()
+    assert wd.stalls, "blocked loop went undetected"
+    assert "unresponsive" in wd.stalls[0]
+
+
+def test_watchdog_never_hangs_on_dead_or_closed_loop():
+    """A loop that exists but never runs records nothing; a closed loop
+    is dropped; stop() returns promptly either way (degrade, never
+    hang)."""
+    wd = LoopWatchdog(threshold=0.05, interval=0.02)
+    dead = asyncio.new_event_loop()
+    wd.watch(dead)
+    time.sleep(0.3)
+    assert wd.stalls == []  # not running -> not stalled
+    dead.close()
+    time.sleep(0.1)  # watchdog notices the close and drops it
+    t0 = time.monotonic()
+    wd.stop()
+    assert time.monotonic() - t0 < 2.0
+    assert wd.stalls == []
+
+
+def test_watchdog_healthy_loop_records_nothing():
+    wd = LoopWatchdog(threshold=0.25, interval=0.02)
+
+    async def body() -> None:
+        wd.watch(asyncio.get_running_loop())
+        for _ in range(10):
+            await asyncio.sleep(0.02)
+
+    asyncio.run(body())
+    wd.stop()
+    assert wd.stalls == []
+
+
+# ---- handoff checker ----
+
+def test_sync_wait_on_loop_thread_recorded():
+    hc = HandoffChecker()
+
+    async def body() -> None:
+        hc.check_sync_wait("_Job.join()")
+
+    asyncio.run(body())
+    assert len(hc.violations) == 1
+    assert "event-loop thread" in hc.violations[0]
+    # off-loop sync waits are the intended shape: no violation
+    hc2 = HandoffChecker()
+    hc2.check_sync_wait("_Job.join()")
+    assert hc2.violations == []
+
+
+def test_resolve_on_wrong_thread_recorded():
+    hc = HandoffChecker()
+
+    async def body() -> None:
+        token = hc.submit_token()
+        hc.check_resolve(token)  # same loop + thread: fine
+        assert hc.violations == []
+        thread = threading.Thread(target=hc.check_resolve,
+                                  args=(token,), daemon=True)
+        thread.start()
+        await asyncio.to_thread(thread.join)
+
+    asyncio.run(body())
+    assert len(hc.violations) == 1
+    assert "off the submitting side" in hc.violations[0]
+
+
+# ---- end-to-end through the pipeline (subprocesses: deliberate
+# violations must not land in this process's global report) ----
+
+def test_pipeline_async_path_is_handoff_clean():
+    proc = _run_py("""
+import asyncio
+import numpy as np
+from chunky_bits_tpu.parallel.host_pipeline import HostPipeline
+from chunky_bits_tpu.analysis import sanitizer
+
+pipe = HostPipeline(threads=2)
+
+
+async def body():
+    big = 1 << 20  # > INLINE_NBYTES: forces the worker hop + bridge
+    out = await pipe.run("t", lambda: sum(range(100)), nbytes=big)
+    assert out == 4950
+
+
+asyncio.run(body())
+
+# the sync scatter APIs are for off-loop callers; with no loop running
+# on this thread they record nothing
+rows = np.zeros((8, 4096), dtype=np.uint8)
+digests = np.empty((8, 32), dtype=np.uint8)
+from chunky_bits_tpu.parallel.host_pipeline import join_jobs
+
+join_jobs(pipe.hash_rows_jobs(rows, digests))
+report = sanitizer.report()
+assert report.handoff_violations == [], report.render()
+assert report.leaked_tasks == [], report.render()
+pipe.close()
+print("CLEAN_OK")
+""", sanitize="1")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "CLEAN_OK" in proc.stdout
+
+
+def test_pipeline_sync_wait_on_loop_detected_end_to_end():
+    proc = _run_py("""
+import asyncio
+import time
+from chunky_bits_tpu.parallel.host_pipeline import HostPipeline
+from chunky_bits_tpu.analysis import sanitizer
+
+pipe = HostPipeline(threads=2)
+
+
+async def body():
+    job = pipe.submit("t", lambda: time.sleep(0.2) or 7)
+    assert job.wait() == 7  # blocking the loop: the violation
+
+
+asyncio.run(body())
+report = sanitizer.report()
+assert report.handoff_violations, "sync loop-thread wait undetected"
+assert "event-loop thread" in report.handoff_violations[0]
+pipe.close()
+print("DETECTED_OK")
+""", sanitize="1")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "DETECTED_OK" in proc.stdout
