@@ -925,6 +925,252 @@ def bench_gateway_put(argv=()) -> None:
         }))
 
 
+def bench_hedged_read(argv=()) -> None:
+    """BASELINE.md config 8: hedged-read tail-latency A/B (CPU-only, no
+    device, no watchdog).  A d=3 p=2 object is written to five
+    in-process HTTP storage nodes, every chunk gets a replica on a
+    second (fast) node, then node 0 is wrapped with injected
+    latency+jitter on every GET — the classic one-slow-replica shape.
+    Reads run once with hedging off (`tunables.hedge_ms = 0`, the
+    default: byte-for-byte the pre-scoreboard location walk) and once
+    with it on; per-part p50/p99 latency, throughput, and request
+    amplification (extra GETs from hedges, budget-capped at ~5%) are
+    reported.  The headline number is the p99 collapse.
+
+    Flags: ``--parts N`` (default 4), ``--chunk-log2 N`` (default 15 =
+    32 KiB), ``--reads N`` timed passes per leg (default 40),
+    ``--delay-ms N`` slow-node injected latency (default 100, +/-25%
+    jitter), ``--hedge-ms N`` hedge delay floor for the ON leg
+    (default 10).
+
+    Failure contract (tests/test_bench_outage.py): ANY failure still
+    emits exactly one parseable JSON line and exits 3."""
+    import asyncio
+    import contextlib
+    import random as _random
+    import tempfile
+
+    argv = list(argv)
+
+    def flag(name, default, cast):
+        if name in argv:
+            return cast(argv[argv.index(name) + 1])
+        return default
+
+    metric = "hedged_read_p99_collapse_d3p2_1slow"
+    try:
+        parts = flag("--parts", 4, int)
+        chunk_log2 = flag("--chunk-log2", 15, int)
+        reads = flag("--reads", 40, int)
+        delay_ms = flag("--delay-ms", 100.0, float)
+        hedge_ms = flag("--hedge-ms", 10.0, float)
+        if parts <= 0 or reads <= 0:
+            raise ValueError("--parts and --reads must be positive")
+        if not (10 <= chunk_log2 <= 24):
+            raise ValueError("--chunk-log2 out of range [10, 24]")
+        if delay_ms < 0 or hedge_ms <= 0:
+            raise ValueError("--delay-ms must be >= 0, --hedge-ms > 0")
+
+        from aiohttp import web
+
+        from chunky_bits_tpu.cluster import Cluster
+        from chunky_bits_tpu.file.location import Location
+        from chunky_bits_tpu.utils import aio
+
+        d, p = 3, 2
+        chunk_bytes = 1 << chunk_log2
+        payload = np.random.default_rng(0).integers(
+            0, 256, parts * d * chunk_bytes, dtype=np.uint8).tobytes()
+
+        class Node:
+            """In-memory HTTP storage node with injectable GET latency
+            (stall, not fail) — the straggler the scheduler must beat."""
+
+            def __init__(self) -> None:
+                self.store: dict[str, bytes] = {}
+                self.gets = 0
+                self.delay_s = 0.0
+                self._rng = _random.Random(1)
+                self._runner = None
+                self.url = ""
+
+            async def _get(self, request):
+                key = request.match_info["key"]
+                self.gets += 1
+                if self.delay_s > 0:
+                    await asyncio.sleep(
+                        self.delay_s * self._rng.uniform(0.75, 1.25))
+                data = self.store.get(key)
+                if data is None:
+                    return web.Response(status=404)
+                return web.Response(body=data)
+
+            async def _put(self, request):
+                self.store[request.match_info["key"]] = \
+                    await request.read()
+                return web.Response()
+
+            async def start(self) -> "Node":
+                app = web.Application()
+                app.router.add_get("/{key:.*}", self._get)
+                app.router.add_put("/{key:.*}", self._put)
+                self._runner = web.AppRunner(app)
+                await self._runner.setup()
+                site = web.TCPSite(self._runner, "127.0.0.1", 0)
+                await site.start()
+                port = site._server.sockets[0].getsockname()[1]
+                self.url = f"http://127.0.0.1:{port}"
+                return self
+
+            async def stop(self) -> None:
+                if self._runner is not None:
+                    await self._runner.cleanup()
+
+        async def run() -> dict:
+            nodes = [await Node().start() for _ in range(5)]
+            try:
+                with contextlib.ExitStack() as stack:
+                    meta = stack.enter_context(
+                        tempfile.TemporaryDirectory())
+
+                    def make_cluster(hedge: float) -> Cluster:
+                        return Cluster.from_obj({
+                            "destinations": [{"location": n.url + "/"}
+                                             for n in nodes],
+                            "metadata": {"type": "path",
+                                         "format": "yaml", "path": meta},
+                            "profiles": {"default": {
+                                "data": d, "parity": p,
+                                "chunk_size": chunk_log2}},
+                            "tunables": {"hedge_ms": hedge},
+                        })
+
+                    writer_cluster = make_cluster(0)
+                    await writer_cluster.write_file(
+                        "obj", aio.BytesReader(payload),
+                        writer_cluster.get_profile())
+                    ref = await writer_cluster.get_file_ref("obj")
+                    await writer_cluster.tunables.location_context() \
+                        .aclose()
+
+                    # replica pass: every chunk gets a second location
+                    # on a FAST node (never node 0 — ONE slow replica
+                    # per chunk is the scenario), so the hedged leg
+                    # always has somewhere to race
+                    fast_i = 1
+                    for part in ref.parts:
+                        for chunk in part.data + part.parity:
+                            key = str(chunk.hash)
+                            owner = next(
+                                n for n in nodes
+                                if str(chunk.locations[0])
+                                .startswith(n.url))
+                            while (nodes[fast_i] is owner
+                                   or fast_i == 0):
+                                fast_i = (fast_i + 1) % len(nodes)
+                            target = nodes[fast_i]
+                            fast_i = (fast_i + 1) % len(nodes)
+                            target.store[key] = owner.store[key]
+                            chunk.locations.append(Location.http(
+                                f"{target.url}/{key}"))
+
+                    nodes[0].delay_s = delay_ms / 1000.0
+
+                    async def leg(hedge: float) -> dict:
+                        cluster = make_cluster(hedge)
+                        cx = cluster.tunables.location_context()
+                        # warm connections (and the first-read breaker
+                        # samples) outside the timed window
+                        for part in ref.parts:
+                            await part.read(cx)
+                        for n in nodes:
+                            n.gets = 0
+                        lat: list[float] = []
+                        t0 = time.perf_counter()
+                        for _ in range(reads):
+                            for part in ref.parts:
+                                s = time.perf_counter()
+                                bufs = await part.read_buffers(cx)
+                                lat.append(time.perf_counter() - s)
+                                del bufs
+                        total_s = time.perf_counter() - t0
+                        requests = sum(n.gets for n in nodes)
+                        # byte-identity gate: whichever location or
+                        # reconstruct path won each race, the object
+                        # must read back exactly
+                        got = await cluster.file_read_builder(ref) \
+                            .read_all()
+                        assert got == payload, \
+                            "hedged-read byte identity failed"
+                        stats = cluster.health_scoreboard().stats()
+                        await cx.aclose()
+                        arr = np.array(lat)
+                        return {
+                            "p50_ms": float(np.percentile(arr, 50))
+                            * 1000.0,
+                            "p99_ms": float(np.percentile(arr, 99))
+                            * 1000.0,
+                            "gibps": reads * len(payload) / total_s
+                            / (1 << 30),
+                            "requests": requests,
+                            "hedges": (stats.hedges_fired,
+                                       stats.hedges_won,
+                                       stats.hedges_cancelled),
+                        }
+
+                    off = await leg(0)
+                    on = await leg(hedge_ms)
+                    return {"off": off, "on": on}
+            finally:
+                for n in nodes:
+                    await n.stop()
+
+        res = asyncio.run(run())
+        off, on = res["off"], res["on"]
+        collapse = (off["p99_ms"] / on["p99_ms"]
+                    if on["p99_ms"] > 0 else 0.0)
+        amplification = (on["requests"] / off["requests"] - 1.0
+                         if off["requests"] else 0.0)
+        fired, won, cancelled = on["hedges"]
+        print(f"# config 8: {parts} parts d={d} p={p} "
+              f"chunk={chunk_bytes >> 10} KiB, slow node "
+              f"{delay_ms:g}ms, hedge {hedge_ms:g}ms, {reads} reads: "
+              f"off p50/p99 {off['p50_ms']:.1f}/{off['p99_ms']:.1f} ms "
+              f"{off['gibps']:.3f} GiB/s | on p50/p99 "
+              f"{on['p50_ms']:.1f}/{on['p99_ms']:.1f} ms "
+              f"{on['gibps']:.3f} GiB/s | p99 collapse "
+              f"{collapse:.1f}x | amplification "
+              f"{amplification * 100:.1f}% | hedges fired/won/"
+              f"cancelled {fired}/{won}/{cancelled}",
+              file=sys.stderr)
+        print(json.dumps({
+            "metric": metric,
+            "value": round(collapse, 2), "unit": "x",
+            # the acceptance target is a >= 5x p99 collapse with one
+            # slow replica; vs_baseline >= 1.0 means criterion met
+            "vs_baseline": round(collapse / 5.0, 2),
+            "p50_off_ms": round(off["p50_ms"], 2),
+            "p99_off_ms": round(off["p99_ms"], 2),
+            "p50_on_ms": round(on["p50_ms"], 2),
+            "p99_on_ms": round(on["p99_ms"], 2),
+            "gibps_off": round(off["gibps"], 3),
+            "gibps_on": round(on["gibps"], 3),
+            "hedge_amplification": round(amplification, 4),
+            "hedges_fired": fired,
+            "hedges_won": won,
+            "hedges_cancelled": cancelled,
+        }))
+    # lint: broad-except-ok the driver contract (ONE parseable JSON
+    # line, always) outranks the traceback; the error text carries it
+    except Exception as err:
+        print(json.dumps({
+            "metric": metric, "value": 0.0, "unit": "x",
+            "vs_baseline": 0.0,
+            "error": f"{type(err).__name__}: {err}",
+        }))
+        sys.exit(3)
+
+
 def bench_small_objects(argv=()) -> None:
     """BASELINE.md config 4's compute core: many concurrent small-object
     encodes (d=8 p=3, 4 MiB objects => [1, 8, S] batches) coalescing
@@ -1027,15 +1273,17 @@ if __name__ == "__main__":
                    "3": lambda: bench_batched_repair(sys.argv),
                    "4": lambda: bench_small_objects(sys.argv),
                    "6": lambda: bench_hot_read(sys.argv),
-                   "7": lambda: bench_gateway_put(sys.argv)}
+                   "7": lambda: bench_gateway_put(sys.argv),
+                   "8": lambda: bench_hedged_read(sys.argv)}
         idx = sys.argv.index("--config") + 1
         which = sys.argv[idx] if idx < len(sys.argv) else ""
         if which not in configs:
-            print(f"usage: bench.py [--config {{1,2,3,4,6,7}}] — the "
+            print(f"usage: bench.py [--config {{1,2,3,4,6,7,8}}] — the "
                   f"device kernel metric (configs 2+3's compute core) is "
                   f"the default no-arg run (got {which!r}); 6 is the "
-                  f"hot-read cache A/B, 7 the gateway PUT ingest A/B "
-                  f"(both CPU-only)", file=sys.stderr)
+                  f"hot-read cache A/B, 7 the gateway PUT ingest A/B, "
+                  f"8 the hedged-read tail-latency A/B (all CPU-only)",
+                  file=sys.stderr)
             sys.exit(2)
         configs[which]()
     else:

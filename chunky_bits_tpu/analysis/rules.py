@@ -14,7 +14,12 @@ from typing import Iterator
 
 #: path prefixes of the device/network call paths — the routes where an
 #: unbounded wait or a non-daemon worker can hang a serve or block exit
-DEVICE_NET_PATHS = ("ops/", "parallel/", "gateway/", "file/chunk_cache.py")
+#: (file_part.py, destination.py and health.py joined with the hedged
+#: I/O scheduler: every await the read race / write failover adds must
+#: stay reachable through a timeout)
+DEVICE_NET_PATHS = ("ops/", "parallel/", "gateway/", "file/chunk_cache.py",
+                    "file/file_part.py", "cluster/destination.py",
+                    "cluster/health.py")
 
 ENV_PREFIX = "CHUNKY_BITS_TPU_"
 

@@ -65,6 +65,16 @@ class Cluster:
         # batcher maps), and a lost race would leak a worker set
         self._own_host_pipeline = None
         self._host_pipeline_lock = threading.Lock()
+        # ONE location-health scoreboard per cluster (cluster/health.py)
+        # shared across every loop and worker thread via the shared
+        # LocationContext (unlike the per-loop batchers/caches: health
+        # memory must span loops — it is thread-safe by construction).
+        # Every read/write completion feeds it; hedged reads arm only
+        # when `tunables.hedge_ms` > 0.
+        from chunky_bits_tpu.cluster.health import HealthScoreboard
+
+        self._health = HealthScoreboard(hedge_ms=self.tunables.hedge_ms)
+        self.tunables.location_context().health = self._health
 
     # ---- serde ----
 
@@ -123,10 +133,19 @@ class Cluster:
         return Destination(
             self.destinations, profile, self.tunables.location_context())
 
+    def health_scoreboard(self):
+        """The cluster's shared location-health scoreboard
+        (cluster/health.py): EWMA latency, error rate, breaker state
+        per storage node, plus the hedged-read budget/counters."""
+        return self._health
+
     def get_destination_with_profiler(
         self, profile: ClusterProfile
     ) -> tuple[object, Destination]:
         profiler, reporter = new_profiler()
+        # write reports carry the per-location health table alongside
+        # the I/O log (the read path attaches it in read_buffers)
+        profiler.attach_health(self._health)
         cx = self.tunables.location_context().but_with(profiler=profiler)
         return reporter, Destination(self.destinations, profile, cx)
 

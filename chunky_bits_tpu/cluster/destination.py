@@ -32,15 +32,20 @@ from typing import Optional, Sequence
 
 from chunky_bits_tpu.cluster.nodes import ClusterNode, ClusterNodes
 from chunky_bits_tpu.cluster.profile import ClusterProfile, ZoneRule
+from chunky_bits_tpu.cluster.tunables import stagger_seconds
 from chunky_bits_tpu.errors import (
     NotEnoughAvailability,
     NotEnoughWriters,
     ShardError,
+    is_transient_error,
 )
 from chunky_bits_tpu.file.hashing import AnyHash
 from chunky_bits_tpu.file.location import Location, LocationContext
 
-STAGGER_SECONDS = 0.1  # writer.rs:246
+#: default writer stagger (writer.rs:246 pins 100 ms); the live value
+#: is read through ``tunables.stagger_seconds()`` at each write so the
+#: knob is env-tunable and CB102-discoverable like every other
+STAGGER_SECONDS = 0.1
 
 
 class _WriterState:
@@ -97,12 +102,31 @@ class _WriterState:
                 if rule.maximum is not None:
                     rule.maximum -= 1
 
+    def _prefer_healthy(self, eligible: list[tuple[int, ClusterNode]]
+                        ) -> list[tuple[int, ClusterNode]]:
+        """Health-aware placement: de-prioritize nodes the scoreboard
+        (cluster/health.py, via the shared LocationContext) marks
+        degraded — open/half-open breaker or error-EWMA past the
+        threshold — BEFORE they hard-fail a write.  Degraded nodes stay
+        eligible as a last resort (capacity beats latency when nothing
+        healthy remains), and with no health data the draw is
+        byte-identical to the reference's (writer.rs:59-97)."""
+        health = self.cx.health
+        if health is None:
+            return eligible
+        preferred = [(i, n) for i, n in eligible
+                     if not health.degraded(n.location.location)]
+        if preferred and sum(n.location.weight
+                             for _i, n in preferred) > 0:
+            return preferred
+        return eligible
+
     async def next_writer(self, hash_: AnyHash
                           ) -> tuple[int, ClusterNode]:
         async with self.lock:
             if not any(v > 0 for v in self.available.values()):
                 raise self._pop_error()
-            eligible = self._eligible()
+            eligible = self._prefer_healthy(self._eligible())
             total_weight = sum(n.location.weight for _i, n in eligible)
             if total_weight == 0:
                 raise self._pop_error()
@@ -151,10 +175,18 @@ class ClusterWriter:
 
     async def write_shard(self, hash_: AnyHash, data: bytes
                           ) -> list[Location]:
+        # Stagger parity (writer.rs:246): writer i waits at most the
+        # stagger window for writer i-1's FIRST placement decision, so
+        # concurrent shard writers of one part serialize their initial
+        # draws (deterministic seeded placement) without ever blocking
+        # on a stuck sibling.  The 100 ms reference constant is the
+        # default of the `tunables.stagger_seconds()` knob
+        # ($CHUNKY_BITS_TPU_STAGGER_SECONDS).
         if self.waiter is not None:
             waiter, self.waiter = self.waiter, None
             try:
-                await asyncio.wait_for(waiter.wait(), STAGGER_SECONDS)
+                await asyncio.wait_for(
+                    waiter.wait(), stagger_seconds(default=STAGGER_SECONDS))
             except asyncio.TimeoutError:
                 pass
         while True:
@@ -164,13 +196,27 @@ class ClusterWriter:
                 if self.staller is not None:
                     self.staller.set()
                     self.staller = None
-            try:
-                location = await node.location.location.write_subfile(
-                    str(hash_), data, self.state.cx)
-            except ShardError as err:
-                await self.state.invalidate_index(index, err)
-            else:
-                return [location]
+            # Transient HTTP failures (408/429/5xx minus 507) get up to
+            # `tunables.read_retries` jittered-backoff retries against
+            # the SAME node before it is invalidated — the reference
+            # invalidates on the first error (writer.rs:99-122), which
+            # ejects a briefly-overloaded node from the whole part.
+            attempt = 0
+            while True:
+                try:
+                    location = await node.location.location.write_subfile(
+                        str(hash_), data, self.state.cx)
+                except ShardError as err:
+                    if attempt < self.state.cx.read_retries \
+                            and is_transient_error(err):
+                        attempt += 1
+                        await asyncio.sleep(
+                            random.uniform(0.025, 0.075) * attempt)
+                        continue
+                    await self.state.invalidate_index(index, err)
+                    break  # draw a different node
+                else:
+                    return [location]
 
 
 class Destination:

@@ -22,6 +22,15 @@ compute pipeline (parallel/host_pipeline.py) that runs per-shard
 SHA-256 and per-stripe GF(2^8) encode for this cluster's ingest and
 verify paths; same YAML-wins/env-default split via
 ``CHUNKY_BITS_TPU_HOST_THREADS``.
+
+``hedge_ms`` (TPU-repo extension, default 0 = off) arms hedged chunk
+reads (cluster/health.py + file/file_part.py): after an adaptive delay
+(scoreboard p95 clamped to [hedge_ms, 20x]) a read races the next-best
+location for the same chunk.  ``read_retries`` (default 1) gives
+transient HTTP errors (408/429/5xx minus 507) one jittered-backoff
+retry per location before fall-through/invalidation.  Both follow the
+YAML-wins/env-default split (``CHUNKY_BITS_TPU_HEDGE_MS`` /
+``CHUNKY_BITS_TPU_READ_RETRIES``).
 """
 
 from __future__ import annotations
@@ -44,6 +53,25 @@ HOST_THREADS_ENV = "CHUNKY_BITS_TPU_HOST_THREADS"
 #: the backend-selection handoff: the CLI --backend flag writes it, the
 #: default resolution in ops/backend.get_backend reads it
 BACKEND_ENV = "CHUNKY_BITS_TPU_BACKEND"
+
+#: hedged-read delay floor in milliseconds (cluster/health.py): after
+#: this long (adaptively stretched to the scoreboard's p95, ceiling
+#: 20x) a chunk read races the next-best location.  0/unset = hedging
+#: off (the default — opt-in until measured, per CLAUDE.md; bench
+#: --config 8 is the A/B).  YAML `hedge_ms` wins; the env var supplies
+#: the default.
+HEDGE_MS_ENV = "CHUNKY_BITS_TPU_HEDGE_MS"
+
+#: per-location retry count for *transient* HTTP errors (408/429/5xx
+#: minus 507) on the read fall-through and the shard-write failover
+#: loop; one jittered backoff per retry.  Default 1; 0 restores
+#: immediate fall-through.
+READ_RETRIES_ENV = "CHUNKY_BITS_TPU_READ_RETRIES"
+
+#: writer stagger: writer i waits this long for writer i-1's first
+#: placement decision (the reference hardcodes 100 ms, writer.rs:246;
+#: routed through here so the knob is discoverable and CB102-clean)
+STAGGER_SECONDS_ENV = "CHUNKY_BITS_TPU_STAGGER_SECONDS"
 
 #: opt-in runtime concurrency sanitizer (analysis/sanitizer.py):
 #: event-loop stall watchdog, task-leak registry, host-pipeline handoff
@@ -130,6 +158,56 @@ def sanitize_enabled() -> bool:
     return env_flag(SANITIZE_ENV)
 
 
+def stagger_seconds(*, default: float = 0.1) -> float:
+    """Shard-writer stagger window: how long writer ``i`` waits for
+    writer ``i-1``'s first placement decision before proceeding
+    (cluster/destination.py).  The reference pins 100 ms
+    (src/cluster/writer.rs:246); this accessor keeps that default while
+    making the knob visible and env-tunable like every other.  Lenient
+    parse — a perf knob can only tune, never crash, placement."""
+    raw = os.environ.get(STAGGER_SECONDS_ENV, "")
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v >= 0 else default
+
+
+def hedge_ms(*, default: float = 0.0) -> float:
+    """Env-supplied default for the ``hedge_ms`` tunable (YAML wins;
+    0 = hedged reads off).  Lenient like ``host_threads`` — malformed
+    or negative values read as off."""
+    raw = os.environ.get(HEDGE_MS_ENV, "")
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def read_retries(*, default: int = 1) -> int:
+    """Env-supplied default for the ``read_retries`` tunable (YAML
+    wins): per-location transient-HTTP retry count on the read
+    fall-through and the shard-write failover loop.  Lenient parse;
+    negative reads as the default."""
+    raw = os.environ.get(READ_RETRIES_ENV, "")
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v >= 0 else default
+
+
+def _default_hedge_ms() -> float:
+    """Env-supplied default for the ``hedge_ms`` tunable."""
+    return hedge_ms(default=0.0)
+
+
+def _default_read_retries() -> int:
+    """Env-supplied default for the ``read_retries`` tunable."""
+    return read_retries(default=1)
+
+
 def _default_host_threads() -> int:
     """Env-supplied default for the ``host_threads`` tunable (YAML wins;
     0 = auto/shared pipeline)."""
@@ -161,6 +239,14 @@ class Tunables:
     #: process-shared auto-sized pipeline.  YAML wins; the
     #: ``CHUNKY_BITS_TPU_HOST_THREADS`` env var supplies the default.
     host_threads: int = field(default_factory=_default_host_threads)
+    #: hedged-read delay floor in milliseconds (cluster/health.py);
+    #: 0 disables hedging (the default — opt-in until measured).  YAML
+    #: wins; ``CHUNKY_BITS_TPU_HEDGE_MS`` supplies the default.
+    hedge_ms: float = field(default_factory=_default_hedge_ms)
+    #: per-location transient-HTTP retry count (reads fall-through +
+    #: shard-write failover); YAML wins over
+    #: ``CHUNKY_BITS_TPU_READ_RETRIES``.
+    read_retries: int = field(default_factory=_default_read_retries)
 
     def is_device_backend(self) -> bool:
         """True when the erasure plane runs on an accelerator ("jax" or a
@@ -173,6 +259,7 @@ class Tunables:
             on_conflict=self.on_conflict,
             https_only=self.https_only,
             user_agent=self.user_agent,
+            read_retries=self.read_retries,
         )
 
     @classmethod
@@ -204,6 +291,26 @@ class Tunables:
             if host_threads_v < 0:
                 raise SerdeError(
                     f"host_threads must be >= 0, got {host_threads_v}")
+        hedge_ms_v = obj.get("hedge_ms", None)
+        if hedge_ms_v is not None:
+            try:
+                hedge_ms_v = float(hedge_ms_v)
+            except (TypeError, ValueError) as err:
+                raise SerdeError(
+                    f"invalid hedge_ms {hedge_ms_v!r}") from err
+            if hedge_ms_v < 0:
+                raise SerdeError(
+                    f"hedge_ms must be >= 0, got {hedge_ms_v}")
+        read_retries_v = obj.get("read_retries", None)
+        if read_retries_v is not None:
+            try:
+                read_retries_v = int(read_retries_v)
+            except (TypeError, ValueError) as err:
+                raise SerdeError(
+                    f"invalid read_retries {read_retries_v!r}") from err
+            if read_retries_v < 0:
+                raise SerdeError(
+                    f"read_retries must be >= 0, got {read_retries_v}")
         return cls(
             https_only=bool(obj.get("https_only", False)),
             on_conflict=on_conflict,
@@ -213,6 +320,10 @@ class Tunables:
                if cache_bytes is not None else {}),
             **({"host_threads": host_threads_v}
                if host_threads_v is not None else {}),
+            **({"hedge_ms": hedge_ms_v}
+               if hedge_ms_v is not None else {}),
+            **({"read_retries": read_retries_v}
+               if read_retries_v is not None else {}),
         )
 
     def to_obj(self) -> dict:
@@ -227,6 +338,10 @@ class Tunables:
             obj["cache_bytes"] = self.cache_bytes
         if self.host_threads > 0:
             obj["host_threads"] = self.host_threads
+        if self.hedge_ms > 0:
+            obj["hedge_ms"] = self.hedge_ms
+        if self.read_retries != 1:
+            obj["read_retries"] = self.read_retries
         return obj
 
     def location_context(self) -> LocationContext:

@@ -38,6 +38,23 @@ class HttpStatusError(LocationError):
         self.url = url
 
 
+#: HTTP statuses worth one jittered-backoff retry against the same
+#: location before falling through (reads) / invalidating the node
+#: (writes).  Other 4xx and 507 are deterministic — retrying a full
+#: disk or a missing chunk only adds latency.
+TRANSIENT_HTTP_STATUSES = frozenset((408, 429, 500, 502, 503, 504))
+
+
+def is_transient_error(err: BaseException) -> bool:
+    """True when ``err`` (a LocationError, or a ShardError wrapping one
+    as its ``__cause__``) names a transient HTTP failure worth a single
+    retry (``tunables.read_retries``)."""
+    for cand in (err, err.__cause__):
+        if isinstance(cand, HttpStatusError):
+            return cand.status in TRANSIENT_HTTP_STATUSES
+    return False
+
+
 class ShardError(ChunkyBitsError):
     """A single shard write failed; carries the failing location
     (src/error.rs:77-97)."""

@@ -26,6 +26,7 @@ from chunky_bits_tpu.errors import (
     LocationError,
     NotEnoughChunks,
     ShardError,
+    is_transient_error,
 )
 from chunky_bits_tpu.file.chunk import Chunk
 from chunky_bits_tpu.file.hashing import AnyHash, Sha256Hash
@@ -273,6 +274,15 @@ class FilePart:
             # a cache hit produces no read log entry at all, so the
             # profiler surfaces the cache's own counters instead
             cx.profiler.attach_cache(cache)
+        # the cluster's location-health scoreboard (cluster/health.py);
+        # None outside a cluster context.  Hedging — racing the
+        # next-best location after an adaptive delay — is armed only by
+        # `tunables.hedge_ms` > 0; with it off this path walks
+        # locations in metadata order exactly as before.
+        health = cx.health
+        hedging = health is not None and health.hedge_enabled
+        if health is not None and cx.profiler is not None:
+            cx.profiler.attach_health(health)
         d, p = len(self.data), len(self.parity)
         # slot payloads are bytes OR zero-copy memoryviews OR rebuilt
         # array views — deliberately untyped (the consumers take buffers)
@@ -326,17 +336,151 @@ class FilePart:
                 nbytes=_buf_len(data))
             return (ok, data)
 
-        async def fetch_chunk(chunk: Chunk) -> Optional[object]:
-            """First verified buffer across the chunk's locations, or
-            None when every location is unreadable/corrupt."""
+        async def read_one(chunk: Chunk, location: Location
+                           ) -> tuple[bool, object]:
+            """``read_verified`` plus up to ``cx.read_retries``
+            jittered-backoff retries against the SAME location for
+            transient HTTP errors (408/429/5xx minus 507) — a
+            momentarily overloaded node should not cost its replica
+            set a fall-through (the reference never retries,
+            src/file/file_part.rs:83-101)."""
+            attempt = 0
+            while True:
+                try:
+                    return await read_verified(chunk, location)
+                except LocationError as err:
+                    if attempt >= cx.read_retries \
+                            or not is_transient_error(err):
+                        raise
+                    attempt += 1
+                    await asyncio.sleep(
+                        random.uniform(0.025, 0.075) * attempt)
+
+        def _corrupt(failures: list, location: Location,
+                     chunk: Chunk) -> None:
+            failures.append(
+                (location, f"hash mismatch (corrupt chunk "
+                           f"{chunk.hash})"))
+            if health is not None:
+                # the I/O hook recorded a successful transfer; corrupt
+                # content is still a demerit for the serving node
+                health.record(location, False)
+
+        async def fetch_serial(chunk: Chunk, failures: list
+                               ) -> Optional[object]:
             for location in chunk.locations:
                 try:
-                    ok, data = await read_verified(chunk, location)
-                except LocationError:
+                    ok, data = await read_one(chunk, location)
+                except LocationError as err:
+                    failures.append((location, str(err)))
                     continue
                 if ok:
                     return data
+                _corrupt(failures, location, chunk)
             return None
+
+        async def fetch_hedged(chunk: Chunk, failures: list
+                               ) -> Optional[object]:
+            """Tail-tolerant fetch (Dean & Barroso, "The Tail at
+            Scale"): fire the best-health location; each time the
+            adaptive hedge delay (scoreboard p95, floored/ceilinged by
+            ``tunables.hedge_ms``) expires with the race undecided —
+            and the global token-bucket budget allows — race the
+            next-best location.  The first VERIFIED buffer wins;
+            losers are cancelled AND awaited so a hedge can never leak
+            a task past its read.  A failed racer falls through to the
+            next location immediately, costing no hedge token."""
+            locs = health.order(chunk.locations)
+            pending: dict[asyncio.Task,
+                          tuple[Location, bool, float]] = {}
+            next_i = 0
+
+            def spawn(is_hedge: bool) -> None:
+                nonlocal next_i
+                location = locs[next_i]
+                next_i += 1
+                task = asyncio.ensure_future(read_one(chunk, location))
+                pending[task] = (location, is_hedge,
+                                 asyncio.get_running_loop().time())
+
+            spawn(is_hedge=False)
+            try:
+                hedge_more = True
+                while pending:
+                    timeout = (health.hedge_delay()
+                               if hedge_more and next_i < len(locs)
+                               else None)
+                    # lint: unbounded-await-ok bounded by construction:
+                    # either the hedge delay, or the racers' own
+                    # network/location timeouts (the same bound the
+                    # serial location walk has always had)
+                    done, _ = await asyncio.wait(
+                        set(pending), timeout=timeout,
+                        return_when=asyncio.FIRST_COMPLETED)
+                    if not done:
+                        if health.try_fire_hedge():
+                            spawn(is_hedge=True)
+                        else:
+                            # budget dry: stop racing this fetch, wait
+                            # out the in-flight attempts
+                            hedge_more = False
+                        continue
+                    for task in done:
+                        location, is_hedge, _t0 = pending.pop(task)
+                        try:
+                            ok, data = task.result()
+                        except LocationError as err:
+                            failures.append((location, str(err)))
+                            continue
+                        if ok:
+                            if is_hedge:
+                                health.hedge_won()
+                            return data
+                        _corrupt(failures, location, chunk)
+                    if not pending and next_i < len(locs):
+                        # every racer failed: plain fall-through to the
+                        # next location, not a hedge
+                        spawn(is_hedge=False)
+                return None
+            finally:
+                if pending:
+                    # the cancelled-hedges counter counts HEDGES only —
+                    # a slow primary cancelled because its hedge won is
+                    # a hedge WIN, not a cancelled hedge
+                    health.hedge_cancelled(
+                        sum(1 for _l, is_h, _t in pending.values()
+                            if is_h))
+                    now = asyncio.get_running_loop().time()
+                    for task, (location, _h, t0) in pending.items():
+                        task.cancel()
+                        # a cancelled loser ran at least (now - t0)
+                        # without producing a verdict: record that as a
+                        # truthful lower-bound latency sample, so the
+                        # scoreboard LEARNS the straggler and demotes
+                        # it — the next read fires the fast replica
+                        # first and needs no hedge token at all
+                        health.record_latency_floor(location, now - t0)
+                    await asyncio.gather(*pending,
+                                         return_exceptions=True)
+
+        async def fetch_chunk(chunk: Chunk) -> Optional[object]:
+            """First verified buffer across the chunk's locations
+            (health-ranked and hedged when armed), or None when every
+            location is unreadable/corrupt.  WHICH location failed and
+            why lands in the profiler's location-failure trail — a
+            degraded cluster must stay diagnosable even though the
+            read itself recovered."""
+            failures: list[tuple[Location, str]] = []
+            if health is not None:
+                health.note_primary()  # hedge-budget accrual
+            if hedging and len(chunk.locations) > 1:
+                data = await fetch_hedged(chunk, failures)
+            else:
+                data = await fetch_serial(chunk, failures)
+            if failures and cx.profiler is not None:
+                for location, err in failures:
+                    cx.profiler.log_location_failure(location, err)
+            return data
 
         async def worker() -> Optional[tuple[int, object]]:
             while True:
@@ -354,13 +498,76 @@ class FilePart:
                 if data is not None:
                     return (index, data)
 
+        async def straggler_race(needed: int) -> None:
+            """The d-of-d+p scheduler's degraded-read race: run the
+            chunk workers, and whenever the adaptive hedge delay
+            passes with workers still out (budget allowing), draw one
+            MORE chunk from the shared pool — by then usually parity —
+            so a straggling data chunk can be counted as missing and
+            beaten by fetch+reconstruct (cf. degraded-read scheduling
+            in the product-matrix/regenerating-codes line, PAPERS.md).
+            The moment >= d slots are filled the stragglers are
+            cancelled and awaited; reconstruction below fills the
+            gaps byte-identically."""
+            tasks = {asyncio.ensure_future(worker())
+                     for _ in range(needed)}
+            extras: set = set()  # hedge-spawned workers, for counters
+            try:
+                hedge_more = True
+                while tasks:
+                    # 2x the location-hedge delay: the per-chunk
+                    # location race gets first shot at a straggler
+                    # (one token); only when THAT hasn't resolved —
+                    # replica slow too, or none left — does the pool
+                    # draw an extra chunk for reconstruction
+                    timeout = (2.0 * health.hedge_delay()
+                               if hedge_more and pool else None)
+                    # lint: unbounded-await-ok bounded by construction:
+                    # the hedge delay, or the workers' own per-location
+                    # network timeouts once the pool/budget is dry
+                    done, _ = await asyncio.wait(
+                        tasks, timeout=timeout,
+                        return_when=asyncio.FIRST_COMPLETED)
+                    if not done:
+                        if pool and health.try_fire_hedge():
+                            extra = asyncio.ensure_future(worker())
+                            tasks.add(extra)
+                            extras.add(extra)
+                        else:
+                            hedge_more = False
+                        continue
+                    tasks -= done
+                    for task in done:
+                        item = task.result()
+                        if item is not None:
+                            slots[item[0]] = item[1]
+                    if tasks and sum(
+                            1 for s in slots if s is not None) >= d:
+                        # any-d-of-d+p satisfied: the stragglers are
+                        # officially "missing" — reconstruct beats
+                        # waiting them out
+                        break
+            finally:
+                # counter semantics: only hedge-spawned extras count as
+                # cancelled hedges — the original workers are the read
+                # itself, not hedge load
+                health.hedge_cancelled(len(tasks & extras))
+                for task in tasks:
+                    task.cancel()
+                if tasks:
+                    await asyncio.gather(*tasks, return_exceptions=True)
+
         # cache hits above already filled some slots; only the shortfall
         # needs workers (a fully hot part spawns none at all)
         needed = max(d - sum(1 for s in slots if s is not None), 0)
-        results = await asyncio.gather(*[worker() for _ in range(needed)])
-        for item in results:
-            if item is not None:
-                slots[item[0]] = item[1]
+        if hedging and needed > 0:
+            await straggler_race(needed)
+        else:
+            results = await asyncio.gather(
+                *[worker() for _ in range(needed)])
+            for item in results:
+                if item is not None:
+                    slots[item[0]] = item[1]
         if not all(slots[i] is not None for i in range(d)):
             present = sum(1 for s in slots if s is not None)
             if present < d:

@@ -83,19 +83,32 @@ class Range:
 
 
 class LocationContext:
-    """Per-operation context: conflict policy, shared HTTP session, optional
-    profiler (src/file/location.rs:447-510)."""
+    """Per-operation context: conflict policy, shared HTTP session,
+    optional profiler, optional location-health scoreboard
+    (src/file/location.rs:447-510).
+
+    ``health`` (a ``cluster.health.HealthScoreboard``, duck-typed to
+    avoid a file->cluster import cycle) receives a completion record
+    for every read / write_subfile / read_view_mapper hit against a
+    location — the feed for latency-ranked ordering, the per-location
+    breaker, and the hedged-read delay.  ``read_retries`` bounds the
+    per-location transient-HTTP retry loops in the read fall-through
+    (file/file_part.py) and the shard-write failover
+    (cluster/destination.py)."""
 
     def __init__(self, on_conflict: str = OVERWRITE,
                  profiler: Optional[Profiler] = None,
                  https_only: bool = False,
-                 user_agent: Optional[str] = None):
+                 user_agent: Optional[str] = None,
+                 read_retries: int = 1):
         if on_conflict not in (OVERWRITE, IGNORE):
             raise ValueError(f"invalid on_conflict {on_conflict!r}")
         self.on_conflict = on_conflict
         self.profiler = profiler
         self.https_only = https_only
         self.user_agent = user_agent
+        self.read_retries = max(int(read_retries), 0)
+        self.health = None  # set by Cluster.__init__ (one per cluster)
         self._sessions: dict[int, object] = {}
 
     def but_with(self, *, on_conflict: Optional[str] = None,
@@ -105,7 +118,9 @@ class LocationContext:
             profiler=profiler if profiler is not None else self.profiler,
             https_only=self.https_only,
             user_agent=self.user_agent,
+            read_retries=self.read_retries,
         )
+        cx.health = self.health  # one scoreboard per cluster
         cx._sessions = self._sessions  # share the connection pools
         return cx
 
@@ -506,14 +521,23 @@ class Location:
         stream at EOF/close/error — the streaming-path hook the reference
         leaves as TODO (src/file/location.rs:119)."""
         cx = cx or default_context()
-        if cx.profiler is None:
-            return await self._open_reader(cx)
         start = time.monotonic()
         try:
             base = await self._open_reader(cx)
         except LocationError as err:
-            cx.profiler.log_read(False, str(err), self, 0, start)
+            # stream-open failure: one health sample (latency to the
+            # error), one profiler entry
+            if cx.health is not None:
+                cx.health.record(self, False, time.monotonic() - start)
+            if cx.profiler is not None:
+                cx.profiler.log_read(False, str(err), self, 0, start)
             raise
+        if cx.health is not None:
+            # the scoreboard times the open (time-to-first-byte proxy);
+            # stream duration depends on the consumer, not the node
+            cx.health.record(self, True, time.monotonic() - start)
+        if cx.profiler is None:
+            return base
         return _ProfiledReader(base, cx.profiler, self, start)
 
     async def _open_reader(self, cx: LocationContext
@@ -573,6 +597,8 @@ class Location:
         (src/file/location.rs:95-113)."""
         cx = cx or default_context()
         start = time.monotonic()
+        if cx.health is not None:
+            cx.health.begin(self)
         try:
             # _open_reader, not reader(): this whole-buffer op logs its own
             # single profiler entry below.
@@ -591,9 +617,20 @@ class Location:
                 # (surfaces as ResourceWarning under -W error)
                 await aio.close_reader(reader)
         except LocationError as err:
+            if cx.health is not None:
+                cx.health.finish(self, False, time.monotonic() - start)
             if cx.profiler is not None:
                 cx.profiler.log_read(False, str(err), self, 0, start)
             raise
+        except BaseException:
+            # cancellation (a hedge loser) or a non-Location failure:
+            # close out the in-flight count without a latency/error
+            # sample — a cancelled racer says nothing about the node
+            if cx.health is not None:
+                cx.health.finish(self, None, None)
+            raise
+        if cx.health is not None:
+            cx.health.finish(self, True, time.monotonic() - start)
         if cx.profiler is not None:
             cx.profiler.log_read(True, None, self, len(out), start)
         return out
@@ -632,10 +669,12 @@ class Location:
                 or aio.mmap_opted_out()):
             return None
         rng = self.range
+        health = cx.health  # thread-safe scoreboard; _map runs off-loop
 
         def _map() -> Optional[memoryview]:
             import mmap
 
+            t0 = time.monotonic()
             try:
                 with open(self.target, "rb") as f:
                     mm = mmap.mmap(f.fileno(), 0,
@@ -651,6 +690,11 @@ class Location:
             if end > len(mm) or start > len(mm):
                 # short range / zero-extension: generic path semantics
                 return None
+            if health is not None:
+                # a None return above is "fast path doesn't apply", not
+                # a node failure — the generic read re-records it; only
+                # a served view is a health sample
+                health.record(self, True, time.monotonic() - t0)
             return memoryview(mm)[start:end]
 
         return _map
@@ -665,8 +709,13 @@ class Location:
         if self.range.is_specified():
             raise WriteToRangeError()
         start = time.monotonic()
+        if cx.health is not None:
+            cx.health.begin(self)
         try:
             if cx.on_conflict == IGNORE and await self.file_exists(cx):
+                if cx.health is not None:
+                    cx.health.finish(self, True,
+                                     time.monotonic() - start)
                 if cx.profiler is not None:
                     cx.profiler.log_write(True, None, self, len(data), start)
                 return
@@ -688,9 +737,17 @@ class Location:
                 if resp.status >= 400:
                     raise HttpStatusError(resp.status, self.target)
         except LocationError as err:
+            if cx.health is not None:
+                cx.health.finish(self, False, time.monotonic() - start)
             if cx.profiler is not None:
                 cx.profiler.log_write(False, str(err), self, len(data), start)
             raise
+        except BaseException:
+            if cx.health is not None:
+                cx.health.finish(self, None, None)  # cancelled: no verdict
+            raise
+        if cx.health is not None:
+            cx.health.finish(self, True, time.monotonic() - start)
         if cx.profiler is not None:
             cx.profiler.log_write(True, None, self, len(data), start)
 
@@ -700,19 +757,31 @@ class Location:
         file (src/file/location.rs:246-309).  Returns bytes written.
         Profiler-hooked (the reference's TODO at location.rs:255)."""
         cx = cx or default_context()
-        if cx.profiler is None:
+        if cx.profiler is None and cx.health is None:
             return await self._write_from_reader_impl(reader, cx)
         start = time.monotonic()
         # Count consumed bytes on the reader side so a stream that fails
         # mid-body still profiles its partial progress.
         counted = aio.CountingReader(reader)
+        if cx.health is not None:
+            cx.health.begin(self)
         try:
             total = await self._write_from_reader_impl(counted, cx)
         except LocationError as err:
-            cx.profiler.log_write(False, str(err), self,
-                                  counted.total, start)
+            if cx.health is not None:
+                cx.health.finish(self, False, time.monotonic() - start)
+            if cx.profiler is not None:
+                cx.profiler.log_write(False, str(err), self,
+                                      counted.total, start)
             raise
-        cx.profiler.log_write(True, None, self, total, start)
+        except BaseException:
+            if cx.health is not None:
+                cx.health.finish(self, None, None)  # cancelled: no verdict
+            raise
+        if cx.health is not None:
+            cx.health.finish(self, True, time.monotonic() - start)
+        if cx.profiler is not None:
+            cx.profiler.log_write(True, None, self, total, start)
         return total
 
     async def _write_from_reader_impl(self, reader: aio.AsyncByteReader,

@@ -40,6 +40,12 @@ class Profiler:
         self._lock = threading.Lock()
         self._caches: list = []  # read caches whose counters we surface
         self._pipelines: list = []  # host pipelines ditto
+        self._healths: list = []  # location-health scoreboards ditto
+        # per-location failure notes from the read fall-through
+        # (fetch_chunk): which location failed / was corrupt and why —
+        # the diagnosable trail the anonymous `except LocationError:
+        # continue` used to swallow
+        self._location_failures: list[tuple[object, str]] = []
 
     def attach_cache(self, cache) -> None:
         """Register a chunk cache so its hit/miss/eviction/singleflight
@@ -70,6 +76,33 @@ class Profiler:
         with self._lock:
             return [p.stats() for p in self._pipelines]
 
+    def attach_health(self, health) -> None:
+        """Register a location-health scoreboard
+        (cluster/health.py) so its per-location table — EWMA latency,
+        error rate, breaker state, hedges fired/won/cancelled — rides
+        along in read/write reports."""
+        with self._lock:
+            if all(h is not health for h in self._healths):
+                self._healths.append(health)
+
+    def health_stats(self) -> list:
+        """Snapshot of each attached scoreboard (HealthStats)."""
+        with self._lock:
+            return [h.stats() for h in self._healths]
+
+    def log_location_failure(self, location, error: str) -> None:
+        """A per-location read failure (unreadable or hash-mismatched)
+        recorded by the chunk fall-through — the read completed via
+        another location or reconstruction, but a degraded cluster must
+        stay diagnosable."""
+        with self._lock:
+            self._location_failures.append((location, error))
+
+    def drain_location_failures(self) -> list[tuple[object, str]]:
+        with self._lock:
+            out, self._location_failures = self._location_failures, []
+        return out
+
     def log_read(self, ok: bool, error: Optional[str], location,
                  length: int, start_time: float) -> None:
         entry = ResultLog("read", ok, error, location, length,
@@ -92,10 +125,13 @@ class Profiler:
 
 class ProfileReport:
     def __init__(self, entries: list[ResultLog], cache_stats: list = (),
-                 pipeline_stats: list = ()):
+                 pipeline_stats: list = (), health_stats: list = (),
+                 location_failures: list = ()):
         self.entries = entries
         self.cache_stats = list(cache_stats)
         self.pipeline_stats = list(pipeline_stats)
+        self.health_stats = list(health_stats)
+        self.location_failures = list(location_failures)
 
     def _avg(self, kind: str) -> Optional[float]:
         durations = [e.duration for e in self.entries if e.kind == kind]
@@ -130,6 +166,15 @@ class ProfileReport:
             base += f" {stats}"
         for stats in self.pipeline_stats:
             base += f" {stats}"
+        for stats in self.health_stats:
+            base += f" {stats}"
+        if self.location_failures:
+            shown = "; ".join(f"{loc}: {err}"
+                              for loc, err in self.location_failures[:8])
+            extra = len(self.location_failures) - 8
+            if extra > 0:
+                shown += f"; +{extra} more"
+            base += f" ReadFailures<{shown}>"
         return base
 
 
@@ -142,7 +187,9 @@ class ProfileReporter:
     def profile(self) -> ProfileReport:
         return ProfileReport(self._profiler.drain(),
                              self._profiler.cache_stats(),
-                             self._profiler.pipeline_stats())
+                             self._profiler.pipeline_stats(),
+                             self._profiler.health_stats(),
+                             self._profiler.drain_location_failures())
 
 
 def new_profiler() -> tuple[Profiler, ProfileReporter]:

@@ -140,6 +140,14 @@ def make_app(cluster: Cluster,
     # not mid-ingest.
     cluster.host_pipeline()
 
+    # Every GET/PUT of this app feeds the cluster's ONE location-health
+    # scoreboard (cluster/health.py) through the shared LocationContext
+    # — concurrent requests therefore share latency/error memory and
+    # the hedge budget, the serve-path analogue of the shared encode
+    # batcher.  On failures the per-node table goes to the log so a
+    # degraded cluster is diagnosable from the gateway side alone.
+    health = cluster.health_scoreboard()
+
     async def handle_get(request: web.Request) -> web.StreamResponse:
         path = request.match_info["path"]
         try:
@@ -209,6 +217,7 @@ def make_app(cluster: Cluster,
             # Detail goes to the log only (error text can embed internal
             # node URLs / filesystem paths).
             log.error("GET %s aborted mid-stream: %s", path, err)
+            log.error("location health at abort: %s", health.stats())
             resp.force_close()
             if request.transport is not None:
                 request.transport.close()
@@ -254,6 +263,8 @@ def make_app(cluster: Cluster,
                 return put_reject(408, "error: ingest too slow\n")
             except ChunkyBitsError as err:
                 log.error("PUT %s failed: %s", path, err)
+                log.error("location health at failure: %s",
+                          health.stats())
                 return put_reject(500, "error: internal error\n")
         return web.Response(status=200)
 

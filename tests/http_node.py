@@ -7,6 +7,8 @@ single-range GET support.  Uses an ephemeral port (the reference pins ports
 
 from __future__ import annotations
 
+import asyncio
+
 from aiohttp import web
 
 
@@ -18,6 +20,14 @@ class FakeHttpNode:
         #: node-wide broken-disk mode: every PUT returns 507
         self.fail_puts = fail_puts
         self.put_attempts = 0
+        self.get_attempts = 0
+        #: node-wide straggler mode: every GET stalls this long before
+        #: answering (stall, not fail — the hedged-read scenario)
+        self.get_delay = 0.0
+        #: one-shot status override: next N PUTs answer with this
+        #: status (transient-retry tests), then normal service resumes
+        self.put_fail_status = 0
+        self.put_fail_remaining = 0
 
     @property
     def url(self) -> str:
@@ -25,6 +35,9 @@ class FakeHttpNode:
 
     async def _get(self, request: web.Request) -> web.Response:
         key = request.match_info["key"]
+        self.get_attempts += 1
+        if self.get_delay > 0:
+            await asyncio.sleep(self.get_delay)
         if key.startswith("redir/"):
             raise web.HTTPFound(location=f"/{key[len('redir/'):]}")
         data = self.store.get(key)
@@ -52,6 +65,9 @@ class FakeHttpNode:
     async def _put(self, request: web.Request) -> web.Response:
         key = request.match_info["key"]
         self.put_attempts += 1
+        if self.put_fail_remaining > 0:
+            self.put_fail_remaining -= 1
+            return web.Response(status=self.put_fail_status)
         if self.fail_puts or key.startswith("fail/"):
             # simulated full/broken disk
             return web.Response(status=507)

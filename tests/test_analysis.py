@@ -69,12 +69,28 @@ def test_unbounded_await_passes_wait_for_and_plain_calls(tmp_path):
 
 
 def test_unbounded_await_out_of_scope_paths_pass(tmp_path):
-    # cluster/ is not a device/network call path
+    # cluster/ at large is not a device/network call path (metadata
+    # subprocess waits etc. are CLI-bounded); only the I/O-scheduler
+    # modules below are in scope
     vs = run_snippet(tmp_path, "cluster/x.py", """
         async def f(evt):
             await evt.wait()
     """, select=("CB101",))
     assert vs == []
+
+
+def test_unbounded_await_covers_io_scheduler_paths(tmp_path):
+    """The hedged-read/write-failover modules joined the CB101 scope
+    with PR 5: every await the location race adds must stay reachable
+    through a timeout."""
+    for i, rel in enumerate(("file/file_part.py",
+                             "cluster/destination.py",
+                             "cluster/health.py")):
+        vs = run_snippet(tmp_path / str(i), rel, """
+            async def f(task):
+                return await task
+        """, select=("CB101",))
+        assert [v.rule for v in vs] == ["CB101"], rel
 
 
 # ---- CB102 env-flag-discipline ----

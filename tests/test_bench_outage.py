@@ -53,6 +53,24 @@ def test_probe_crash_is_not_an_outage():
     assert "boom" in rec["error"]
 
 
+def test_config8_failure_emits_one_json_line():
+    """--config 8 (hedged-read A/B, CPU-only) honors the same driver
+    contract as the device configs: ANY failure still produces exactly
+    one parseable JSON line on stdout and exit code 3."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "8", "--reads", "0"],
+        cwd=REPO, env=env, capture_output=True, timeout=120)
+    assert r.returncode == 3, r.stderr.decode()[-500:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec
+    assert rec["value"] == 0.0
+    assert "error" in rec
+
+
 def test_seams_only_shrink_and_tolerate_garbage():
     """Inherited env values must not break the contract: malformed or
     larger-than-default values fall back to the real budget."""

@@ -143,6 +143,104 @@ def test_chaos_soak(tmp_path, seed):
     asyncio.run(main())
 
 
+def test_chaos_slow_location_hedged(tmp_path):
+    """Straggler chaos (stall, not fail): every chunk has two replicas
+    and one node serves with a 500 ms stall.  A hedged read
+    (`tunables.hedge_ms`) must complete near the FAST replica's
+    latency — far under one stall — and bytes must be identical
+    whichever location wins the race: slow-node-primary (replica wins),
+    fast-primary (primary wins), and hedging-off (the stall is simply
+    paid) must all agree."""
+    import time
+
+    from chunky_bits_tpu.file.location import Location
+    from tests.http_node import FakeHttpNode
+
+    rng = np.random.default_rng(11)
+    meta = tmp_path / "meta"
+    meta.mkdir()
+    payload = rng.integers(0, 256, 150000, dtype=np.uint8).tobytes()
+
+    async def main():
+        nodes = [await FakeHttpNode().start() for _ in range(5)]
+        try:
+            def make_cluster(hedge_ms):
+                return Cluster.from_obj({
+                    "destinations": [{"location": n.url + "/"}
+                                     for n in nodes],
+                    "metadata": {"type": "path", "format": "yaml",
+                                 "path": str(meta)},
+                    "profiles": {"default": {"data": 3, "parity": 2,
+                                             "chunk_size": 14}},
+                    "tunables": {"hedge_ms": hedge_ms},
+                })
+
+            writer = make_cluster(0)
+            await writer.write_file("obj", aio.BytesReader(payload),
+                                    writer.get_profile())
+            ref = await writer.get_file_ref("obj")
+            # replicate every chunk onto a second node, never node 0:
+            # node 0 is the one slow replica of the scenario
+            pick = 1
+            for part in ref.parts:
+                for chunk in part.data + part.parity:
+                    key = str(chunk.hash)
+                    owner = next(n for n in nodes
+                                 if str(chunk.locations[0])
+                                 .startswith(n.url))
+                    while nodes[pick] is owner or pick == 0:
+                        pick = (pick + 1) % len(nodes)
+                    nodes[pick].store[key] = owner.store[key]
+                    chunk.locations.append(
+                        Location.http(f"{nodes[pick].url}/{key}"))
+                    pick = (pick + 1) % len(nodes)
+            await writer.write_file_ref("obj", ref)
+
+            async def read_all(cluster):
+                r = await cluster.get_file_ref("obj")
+                return await cluster.file_read_builder(r).read_all()
+
+            # hedging OFF pays the stall but stays byte-identical
+            nodes[0].get_delay = 0.5
+            cold = make_cluster(0)
+            t0 = time.monotonic()
+            assert await read_all(cold) == payload
+            off_elapsed = time.monotonic() - t0
+            assert off_elapsed >= 0.5, \
+                "expected the unhedged read to pay the stall"
+
+            # hedging ON completes near the fast replica's latency:
+            # every stalled primary is raced after ~25 ms
+            hedged = make_cluster(25)
+            t0 = time.monotonic()
+            assert await read_all(hedged) == payload
+            on_elapsed = time.monotonic() - t0
+            assert on_elapsed < 0.5, (
+                f"hedged read took {on_elapsed:.3f}s — it waited out "
+                f"the 0.5s stall instead of racing the fast replica")
+            # repeat reads ride the scoreboard's ordering (slow node
+            # demoted) and stay identical
+            assert await read_all(hedged) == payload
+
+            # flip the slow side: now the REPLICA side added above is
+            # never slow, node 0 is fast again and a different node
+            # stalls — whichever location wins, bytes are identical
+            nodes[0].get_delay = 0.0
+            nodes[2].get_delay = 0.35
+            flipped = make_cluster(25)
+            assert await read_all(flipped) == payload
+            stats = hedged.health_scoreboard().stats()
+            assert stats.hedges_fired >= 1, \
+                f"no hedges fired against a stalling node: {stats}"
+            for cluster in (cold, hedged, flipped, writer):
+                await cluster.tunables.location_context().aclose()
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    asyncio.run(main())
+
+
 def test_chaos_soak_http_nodes(tmp_path):
     """The same invariants over in-process HTTP storage nodes: damage is
     dropped/corrupted in the node stores, repair re-places over HTTP."""

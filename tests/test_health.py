@@ -1,0 +1,560 @@
+"""Location-health scoreboard, breaker, hedge budget, and the wiring.
+
+Unit-level pins for cluster/health.py (EWMA math, breaker transitions,
+token-bucket exhaustion, ordering) plus the integration seams the
+tentpole added: tunables serde for hedge_ms/read_retries, health-aware
+writer placement, transient-HTTP retries on both planes, and the
+profiler's per-location failure trail (a degraded cluster must be
+diagnosable).  The end-to-end hedged-read race lives in
+tests/test_chaos.py::test_chaos_slow_location_hedged; bench --config 8
+is the measured A/B.
+"""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+from chunky_bits_tpu.cluster.health import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    HealthScoreboard,
+    location_key,
+)
+from chunky_bits_tpu.errors import (
+    HttpStatusError,
+    LocationError,
+    ShardError,
+    is_transient_error,
+)
+from chunky_bits_tpu.file.location import Location
+
+
+class Clock:
+    """Deterministic injectable monotonic clock."""
+
+    def __init__(self) -> None:
+        self.now = 1000.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def loc(url: str) -> Location:
+    return Location.parse(url)
+
+
+# ---- identity ----
+
+def test_location_key_groups_by_node():
+    a = loc("http://10.0.0.1:8080/chunks/sha256-aa")
+    b = loc("http://10.0.0.1:8080/chunks/sha256-bb")
+    c = loc("http://10.0.0.2:8080/chunks/sha256-aa")
+    assert location_key(a) == location_key(b)
+    assert location_key(a) != location_key(c)
+    d1 = loc("/disk0/sha256-aa")
+    d2 = loc("/disk0/sha256-bb")
+    d3 = loc("/disk1/sha256-aa")
+    assert location_key(d1) == location_key(d2)
+    assert location_key(d1) != location_key(d3)
+
+
+# ---- EWMA / ordering ----
+
+def test_ewma_latency_and_order():
+    sb = HealthScoreboard()
+    fast, slow = loc("http://fast:1/x"), loc("http://slow:1/x")
+    for _ in range(10):
+        sb.record(fast, True, 0.002)
+        sb.record(slow, True, 0.200)
+    ranked = sb.order([slow, fast])
+    assert ranked == [fast, slow]
+    rows = {r.key[1]: r for r in sb.stats().locations}
+    assert rows["fast:1"].ewma_ms == pytest.approx(2.0, rel=0.2)
+    assert rows["slow:1"].ewma_ms == pytest.approx(200.0, rel=0.3)
+
+
+def test_order_is_stable_for_unknown_locations():
+    """A fresh scoreboard must reproduce metadata order exactly — the
+    hedging-off default walk is pinned byte-for-byte to the pre-PR
+    (and reference, file_part.rs:83-101) behavior."""
+    sb = HealthScoreboard()
+    locs = [loc(f"http://n{i}:1/x") for i in range(6)]
+    assert sb.order(locs) == locs
+
+
+def test_error_rate_ranks_failing_node_last():
+    sb = HealthScoreboard()
+    ok, bad = loc("http://ok:1/x"), loc("http://bad:1/x")
+    sb.record(ok, True, 0.01)
+    for _ in range(3):
+        sb.record(bad, False, 0.01)
+    assert sb.order([bad, ok]) == [ok, bad]
+
+
+# ---- breaker ----
+
+def test_breaker_closed_open_halfopen_cycle():
+    clock = Clock()
+    sb = HealthScoreboard(clock=clock)
+    node = loc("http://flaky:1/x")
+    assert sb.breaker_state(node) == CLOSED
+    for _ in range(sb.BREAKER_FAILURES - 1):
+        sb.record(node, False)
+    assert sb.breaker_state(node) == CLOSED  # one short of the trip
+    sb.record(node, False)
+    assert sb.breaker_state(node) == OPEN
+    assert sb.degraded(node)
+    # cooldown elapses -> half-open (one probe allowed)
+    clock.now += sb.BREAKER_COOLDOWN + 0.1
+    assert sb.breaker_state(node) == HALF_OPEN
+    # a half-open failure re-opens immediately (no 5-strike grace)
+    sb.record(node, False)
+    assert sb.breaker_state(node) == OPEN
+    clock.now += sb.BREAKER_COOLDOWN + 0.1
+    assert sb.breaker_state(node) == HALF_OPEN
+    # a successful probe closes
+    sb.record(node, True, 0.01)
+    assert sb.breaker_state(node) == CLOSED
+
+
+def test_open_breaker_orders_last_but_stays_usable():
+    clock = Clock()
+    sb = HealthScoreboard(clock=clock)
+    dead, fine = loc("http://dead:1/x"), loc("http://fine:1/x")
+    for _ in range(sb.BREAKER_FAILURES):
+        sb.record(dead, False)
+    # dead first in metadata order, but ranked last — never dropped
+    ranked = sb.order([dead, fine])
+    assert ranked == [fine, dead]
+    assert len(ranked) == 2
+
+
+# ---- hedge budget ----
+
+def test_hedge_budget_exhaustion_and_accrual():
+    sb = HealthScoreboard(hedge_ms=10.0)
+    assert sb.hedge_enabled
+    # the bucket starts at the burst cap
+    burst = int(sb._hedge_burst)
+    for _ in range(burst):
+        assert sb.try_fire_hedge()
+    assert not sb.try_fire_hedge(), "budget should be exhausted"
+    assert sb.hedges_fired == burst
+    # accrual: 1/hedge_ratio primaries buy exactly one token
+    for _ in range(int(1 / 0.05) - 1):
+        sb.note_primary()
+        assert not sb.try_fire_hedge()
+    sb.note_primary()
+    assert sb.try_fire_hedge()
+
+
+def test_hedge_delay_clamps_to_floor_and_ceiling():
+    sb = HealthScoreboard(hedge_ms=10.0)
+    # no samples: the floor
+    assert sb.hedge_delay() == pytest.approx(0.010)
+    # tiny latencies: still the floor
+    for _ in range(50):
+        sb.record(loc("http://a:1/x"), True, 0.0001)
+    assert sb.hedge_delay() == pytest.approx(0.010)
+    # huge latencies: the ceiling (20x floor)
+    for _ in range(200):
+        sb.record(loc("http://a:1/x"), True, 5.0)
+    assert sb.hedge_delay() == pytest.approx(0.200)
+    # mid-range latencies: tracks the p95
+    sb2 = HealthScoreboard(hedge_ms=10.0)
+    for _ in range(100):
+        sb2.record(loc("http://a:1/x"), True, 0.050)
+    assert sb2.hedge_delay() == pytest.approx(0.050, rel=0.05)
+
+
+def test_hedging_disabled_by_default():
+    sb = HealthScoreboard()
+    assert not sb.hedge_enabled
+
+
+def test_latency_floor_learns_without_verdict():
+    """A cancelled hedge loser's lower-bound sample must move the EWMA
+    (so ordering demotes the straggler) but neither count as success
+    nor failure — in particular it must NOT close an open breaker."""
+    clock = Clock()
+    sb = HealthScoreboard(clock=clock)
+    slow, fast = loc("http://slow:1/x"), loc("http://fast:1/x")
+    sb.record(fast, True, 0.002)
+    sb.record_latency_floor(slow, 0.050)
+    assert sb.order([slow, fast]) == [fast, slow]
+    row = {r.key[1]: r for r in sb.stats().locations}["slow:1"]
+    assert row.err_rate == pytest.approx(0.0)
+    # an open breaker stays open through a floor sample
+    for _ in range(sb.BREAKER_FAILURES):
+        sb.record(slow, False)
+    assert sb.breaker_state(slow) == OPEN
+    sb.record_latency_floor(slow, 0.100)
+    assert sb.breaker_state(slow) == OPEN
+
+
+def test_inflight_pairing_and_cancel_verdict():
+    sb = HealthScoreboard()
+    node = loc("http://n:1/x")
+    sb.begin(node)
+    sb.begin(node)
+    assert sb.stats().locations[0].inflight == 2
+    sb.finish(node, True, 0.01)
+    # ok=None (cancelled racer): in-flight closes, no err/latency sample
+    sb.finish(node, None, None)
+    row = sb.stats().locations[0]
+    assert row.inflight == 0
+    assert row.err_rate == pytest.approx(0.0)
+    assert row.completions == 1
+
+
+def test_scoreboard_is_thread_safe():
+    """Completions arrive from loop callbacks AND pipeline worker
+    threads; hammer from several threads and check totals."""
+    sb = HealthScoreboard(hedge_ms=5.0)
+    node = loc("http://n:1/x")
+    n_threads, per = 4, 500
+
+    def work():
+        for i in range(per):
+            sb.begin(node)
+            sb.finish(node, i % 10 != 0, 0.001)
+            sb.note_primary()
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    row = sb.stats().locations[0]
+    assert row.completions == n_threads * per
+    assert row.inflight == 0
+
+
+# ---- transient classification ----
+
+def test_transient_error_classification():
+    assert is_transient_error(HttpStatusError(503, "http://n/x"))
+    assert is_transient_error(HttpStatusError(429, "http://n/x"))
+    assert not is_transient_error(HttpStatusError(404, "http://n/x"))
+    assert not is_transient_error(HttpStatusError(507, "http://n/x")), \
+        "a full disk is deterministic, not transient"
+    assert not is_transient_error(LocationError("connection refused"))
+    # ShardError wrapping a transient cause (the write plane's shape)
+    err = ShardError("write failed")
+    err.__cause__ = HttpStatusError(500, "http://n/x")
+    assert is_transient_error(err)
+
+
+# ---- tunables serde ----
+
+def test_tunables_hedge_and_retry_serde(monkeypatch):
+    from chunky_bits_tpu.cluster.tunables import Tunables
+
+    # the CI hedge leg exports these globally; the serde defaults under
+    # test are the no-env ones
+    monkeypatch.delenv("CHUNKY_BITS_TPU_HEDGE_MS", raising=False)
+    monkeypatch.delenv("CHUNKY_BITS_TPU_READ_RETRIES", raising=False)
+    t = Tunables.from_obj({"hedge_ms": 15, "read_retries": 2})
+    assert t.hedge_ms == 15.0
+    assert t.read_retries == 2
+    obj = t.to_obj()
+    assert obj["hedge_ms"] == 15.0
+    assert obj["read_retries"] == 2
+    t2 = Tunables.from_obj(obj)
+    assert (t2.hedge_ms, t2.read_retries) == (15.0, 2)
+    # defaults: hedging off, one retry — and neither serialized
+    t3 = Tunables.from_obj({})
+    assert t3.hedge_ms == 0.0
+    assert t3.read_retries == 1
+    assert "hedge_ms" not in t3.to_obj()
+    assert "read_retries" not in t3.to_obj()
+    # context carries the retry bound to both planes
+    assert t.location_context().read_retries == 2
+    from chunky_bits_tpu.errors import SerdeError
+
+    with pytest.raises(SerdeError):
+        Tunables.from_obj({"hedge_ms": -1})
+    with pytest.raises(SerdeError):
+        Tunables.from_obj({"read_retries": "many"})
+
+
+def test_tunables_env_defaults(monkeypatch):
+    from chunky_bits_tpu.cluster import tunables
+
+    monkeypatch.setenv("CHUNKY_BITS_TPU_HEDGE_MS", "12.5")
+    monkeypatch.setenv("CHUNKY_BITS_TPU_READ_RETRIES", "3")
+    t = tunables.Tunables.from_obj({})
+    assert t.hedge_ms == 12.5
+    assert t.read_retries == 3
+    # YAML wins over env
+    t2 = tunables.Tunables.from_obj({"hedge_ms": 0, "read_retries": 0})
+    assert t2.hedge_ms == 0.0
+    assert t2.read_retries == 0
+    # malformed env values are lenient (perf knobs can't crash startup)
+    monkeypatch.setenv("CHUNKY_BITS_TPU_HEDGE_MS", "fast")
+    monkeypatch.setenv("CHUNKY_BITS_TPU_READ_RETRIES", "-2")
+    assert tunables.hedge_ms() == 0.0
+    assert tunables.read_retries() == 1
+    monkeypatch.setenv("CHUNKY_BITS_TPU_STAGGER_SECONDS", "0.02")
+    assert tunables.stagger_seconds() == 0.02
+    monkeypatch.setenv("CHUNKY_BITS_TPU_STAGGER_SECONDS", "soon")
+    assert tunables.stagger_seconds() == 0.1
+
+
+# ---- health-aware writes ----
+
+def test_next_writer_deprioritizes_open_breaker(tmp_path):
+    """With node 0's breaker open, placement prefers the healthy nodes
+    BEFORE node 0 hard-fails a write; with all nodes healthy the
+    hash-seeded draw stays byte-identical to the reference's."""
+    from chunky_bits_tpu.cluster.nodes import ClusterNodes
+    from chunky_bits_tpu.cluster.profile import ClusterProfile
+    from chunky_bits_tpu.cluster.destination import _WriterState
+    from chunky_bits_tpu.file.hashing import AnyHash
+    from chunky_bits_tpu.file.location import LocationContext
+
+    dirs = []
+    for i in range(4):
+        d = tmp_path / f"disk{i}"
+        d.mkdir()
+        dirs.append(str(d))
+    nodes = ClusterNodes.from_obj([{"location": x} for x in dirs])
+    profile = ClusterProfile.from_obj({"data": 1, "parity": 0})
+    hash_ = AnyHash.from_buf(b"seed")
+
+    async def draw(health):
+        cx = LocationContext()
+        cx.health = health
+        state = _WriterState(nodes, profile, cx)
+        picked = set()
+        for _ in range(4):
+            index, _node = await state.next_writer(hash_)
+            picked.add(index)
+        return picked
+
+    async def main():
+        baseline = await draw(None)
+        assert baseline == {0, 1, 2, 3}  # all slots drain eventually
+
+        sb = HealthScoreboard()
+        bad = Location.local(str(tmp_path / "disk0" / "chunk"))
+        for _ in range(sb.BREAKER_FAILURES):
+            sb.record(bad, False)
+        assert sb.degraded(bad)
+        # first three draws avoid the degraded node entirely...
+        cx = LocationContext()
+        cx.health = sb
+        state = _WriterState(nodes, profile, cx)
+        first_three = {(await state.next_writer(hash_))[0]
+                       for _ in range(3)}
+        assert 0 not in first_three
+        # ...but it remains the last resort, not a hard failure
+        index, _node = await state.next_writer(hash_)
+        assert index == 0
+
+    asyncio.run(main())
+
+
+def test_write_shard_retries_transient_http(tmp_path):
+    """A 503 on PUT gets one jittered retry against the SAME node
+    before invalidation (tunables.read_retries); a 507 (full disk)
+    stays an immediate invalidate+failover, pinning the pre-PR
+    failover behavior."""
+    from chunky_bits_tpu.cluster import Cluster
+    from chunky_bits_tpu.utils import aio
+    from tests.http_node import FakeHttpNode
+
+    rng = np.random.default_rng(5)
+    payload = rng.integers(0, 256, 30000, dtype=np.uint8).tobytes()
+    meta = tmp_path / "meta"
+    meta.mkdir()
+
+    async def main():
+        flaky = await FakeHttpNode().start()
+        steady = [await FakeHttpNode().start() for _ in range(5)]
+        try:
+            flaky.put_fail_status = 503
+            flaky.put_fail_remaining = 10**6  # every PUT 503s, for now
+            cluster = Cluster.from_obj({
+                "destinations": [{"location": n.url + "/"}
+                                 for n in [flaky] + steady],
+                "metadata": {"type": "path", "format": "yaml",
+                             "path": str(meta)},
+                "profiles": {"default": {"data": 3, "parity": 2,
+                                         "chunk_size": 13}},
+            })
+            await cluster.write_file("obj", aio.BytesReader(payload),
+                                     cluster.get_profile())
+            got = await (await cluster.get_file_ref("obj")) \
+                .read_builder().read_all()
+            assert got == payload
+            # the flaky node was retried at least once before failover:
+            # >= 2 attempts for the one shard routed to it (stagger
+            # serializes the first draws, so exactly one shard hits it)
+            assert flaky.put_attempts >= 2, flaky.put_attempts
+            await cluster.tunables.location_context().aclose()
+        finally:
+            await flaky.stop()
+            for n in steady:
+                await n.stop()
+
+    asyncio.run(main())
+
+
+def test_transient_put_succeeds_on_retry(tmp_path):
+    """One 503 then service: the shard lands on the SAME node via the
+    retry, no failover draw at all."""
+    from chunky_bits_tpu.file.hashing import AnyHash
+    from chunky_bits_tpu.cluster.nodes import ClusterNodes
+    from chunky_bits_tpu.cluster.profile import ClusterProfile
+    from chunky_bits_tpu.cluster.destination import (
+        ClusterWriter,
+        _WriterState,
+    )
+    from chunky_bits_tpu.file.location import LocationContext
+    from tests.http_node import FakeHttpNode
+
+    async def main():
+        node = await FakeHttpNode().start()
+        try:
+            node.put_fail_status = 503
+            node.put_fail_remaining = 1
+            nodes = ClusterNodes.from_obj([{"location": node.url + "/"}])
+            state = _WriterState(
+                nodes, ClusterProfile.from_obj({"data": 1, "parity": 0}),
+                LocationContext())
+            writer = ClusterWriter(state, None, None)
+            hash_ = AnyHash.from_buf(b"payload")
+            locations = await writer.write_shard(hash_, b"payload")
+            assert len(locations) == 1
+            assert node.put_attempts == 2  # the 503, then the retry
+            assert str(hash_) in node.store
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
+
+
+# ---- diagnosability (the anonymous-swallow satellite) ----
+
+def test_profiler_records_which_location_failed(tmp_path):
+    """fetch_chunk used to swallow every LocationError anonymously;
+    the profiler now carries (location, why) for each failed or
+    corrupt location even though the read itself recovered."""
+    from chunky_bits_tpu.file.chunk import Chunk
+    from chunky_bits_tpu.file.file_part import FilePart
+    from chunky_bits_tpu.file.hashing import AnyHash
+    from chunky_bits_tpu.file.location import LocationContext
+    from chunky_bits_tpu.file.profiler import new_profiler
+
+    rng = np.random.default_rng(9)
+    data = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+    shard = data[:2048], data[2048:]
+    chunks = []
+    for i, payload in enumerate(shard):
+        good = tmp_path / f"chunk{i}"
+        good.write_bytes(payload)
+        missing = str(tmp_path / "gone" / f"chunk{i}")
+        chunks.append(Chunk(
+            hash=AnyHash.from_buf(payload),
+            # first location unreadable -> must be reported, not
+            # silently skipped
+            locations=[Location.local(missing),
+                       Location.local(str(good))]))
+    part = FilePart(chunksize=2048, data=chunks, parity=[])
+
+    async def main():
+        profiler, reporter = new_profiler()
+        cx = LocationContext(profiler=profiler)
+        got = await part.read(cx)
+        assert got == data
+        report = reporter.profile()
+        assert len(report.location_failures) == 2
+        failed_locations = {str(l) for l, _e in report.location_failures}
+        assert all("/gone/" in s for s in failed_locations)
+        assert "ReadFailures<" in str(report)
+
+    asyncio.run(main())
+
+
+def test_corrupt_location_is_reported_and_demerited(tmp_path):
+    from chunky_bits_tpu.file.chunk import Chunk
+    from chunky_bits_tpu.file.file_part import FilePart
+    from chunky_bits_tpu.file.hashing import AnyHash
+    from chunky_bits_tpu.file.location import LocationContext
+    from chunky_bits_tpu.file.profiler import new_profiler
+
+    payload = b"x" * 4096
+    corrupt = tmp_path / "bad" / "chunk0"
+    corrupt.parent.mkdir()
+    corrupt.write_bytes(b"y" * 4096)
+    good = tmp_path / "good" / "chunk0"
+    good.parent.mkdir()
+    good.write_bytes(payload)
+    chunk = Chunk(hash=AnyHash.from_buf(payload),
+                  locations=[Location.local(str(corrupt)),
+                             Location.local(str(good))])
+    part = FilePart(chunksize=4096, data=[chunk], parity=[])
+
+    async def main():
+        profiler, reporter = new_profiler()
+        cx = LocationContext(profiler=profiler)
+        cx.health = HealthScoreboard()
+        got = await part.read(cx)
+        assert got == payload
+        report = reporter.profile()
+        assert len(report.location_failures) == 1
+        _loc, why = report.location_failures[0]
+        assert "hash mismatch" in why
+        # corruption is a health demerit for the serving node
+        assert cx.health.stats().locations, "no health rows recorded"
+        rows = {r.key: r for r in cx.health.stats().locations}
+        bad_row = rows[location_key(chunk.locations[0])]
+        assert bad_row.errors >= 1
+
+    asyncio.run(main())
+
+
+# ---- hedged read: byte identity under the race, scoreboard counters ----
+
+def test_hedged_read_byte_identity_fuzz(tmp_path):
+    """Conformance fuzz for the race: random objects, every chunk
+    replicated, random per-read winner (no injected latency — both
+    sides are live, so either may win); bytes must always be identical
+    to hedging-off."""
+    from chunky_bits_tpu.file.chunk import Chunk
+    from chunky_bits_tpu.file.file_part import FilePart
+    from chunky_bits_tpu.file.hashing import AnyHash
+    from chunky_bits_tpu.file.location import LocationContext
+
+    rng = np.random.default_rng(21)
+
+    async def main():
+        for trial in range(6):
+            d = int(rng.integers(2, 5))
+            chunksize = int(rng.integers(100, 5000))
+            chunks = []
+            want = []
+            for ci in range(d):
+                payload = rng.integers(
+                    0, 256, chunksize, dtype=np.uint8).tobytes()
+                want.append(payload)
+                locations = []
+                for rep in range(2):
+                    f = tmp_path / f"t{trial}" / f"r{rep}" / f"c{ci}"
+                    f.parent.mkdir(parents=True, exist_ok=True)
+                    f.write_bytes(payload)
+                    locations.append(Location.local(str(f)))
+                chunks.append(Chunk(hash=AnyHash.from_buf(payload),
+                                    locations=locations))
+            part = FilePart(chunksize=chunksize, data=chunks, parity=[])
+            # aggressive floor: hedges fire on essentially every fetch
+            cx = LocationContext()
+            cx.health = HealthScoreboard(hedge_ms=0.001)
+            got = await part.read(cx)
+            assert got == b"".join(want), f"trial {trial} mismatch"
+
+    asyncio.run(main())
