@@ -1171,6 +1171,406 @@ def bench_hedged_read(argv=()) -> None:
         sys.exit(3)
 
 
+def bench_gateway_scaleout(argv=()) -> None:
+    """BASELINE.md config 9: the gateway scale-out A/B (CPU-only, no
+    device, no watchdog).  Hundreds of concurrent keep-alive clients
+    hammer a REAL multi-process gateway fleet (gateway/workers.py:
+    SO_REUSEPORT workers under the supervisor) with the mixed read
+    traffic of a serving frontend — full-body hot reads, within-chunk
+    ranges (the sendfile fast path), conditional GETs (If-None-Match →
+    304), and periodic large objects — once per worker count in the
+    sweep.  Reports RPS and p50/p99/p999 client-side latency per leg
+    (percentiles via file/profiler.percentile — the SAME code the
+    gateway access log uses, so bench and production numbers agree by
+    construction), plus the 304-vs-full-body hot-read comparison.
+    Every body is compared against the source payload, so the run is
+    also the sendfile-vs-reassembly byte-identity gate.
+
+    Flags: ``--clients N`` concurrent keep-alive clients (default
+    200), ``--rounds N`` request rounds per client (default 5),
+    ``--sweep-workers 1,2[,4]`` worker counts (default "1,2" — the 1 vs
+    N A/B; both legs run under the supervisor so the comparison is
+    pure worker count), ``--no-sendfile`` forces the reassembly path in
+    every worker (the sendfile A/B leg), ``--smoke`` shrinks everything
+    to a seconds-scale contract check (8 clients, 2 rounds, 1 worker).
+
+    Failure contract (tests/test_bench_outage.py): ANY failure still
+    emits exactly one parseable JSON line and exits 3."""
+    import asyncio
+    import contextlib
+    import os
+    import random as _random
+    import tempfile
+
+    argv = list(argv)
+
+    def flag(name, default, cast):
+        if name in argv:
+            return cast(argv[argv.index(name) + 1])
+        return default
+
+    metric_base = "gateway_scaleout_rps_d3p2_mixed"
+    try:
+        smoke = "--smoke" in argv
+        clients = flag("--clients", 8 if smoke else 200, int)
+        rounds = flag("--rounds", 2 if smoke else 5, int)
+        sweep = flag("--sweep-workers", "1" if smoke else "1,2", str)
+        no_sendfile = "--no-sendfile" in argv
+        worker_counts = [int(x) for x in sweep.split(",")]
+        if clients <= 0 or rounds <= 0 or not worker_counts \
+                or any(w <= 0 for w in worker_counts):
+            raise ValueError("--clients/--rounds/--sweep-workers must "
+                             "be positive")
+
+        import aiohttp
+
+        from chunky_bits_tpu.cluster import Cluster
+        from chunky_bits_tpu.cluster.tunables import GATEWAY_SENDFILE_ENV
+        from chunky_bits_tpu.file.profiler import percentile
+        from chunky_bits_tpu.gateway.workers import GatewaySupervisor
+        from chunky_bits_tpu.utils import aio
+
+        if no_sendfile:
+            # the one sanctioned env handoff shape (a WRITE, like the
+            # CLI's backend flag): workers inherit it at spawn
+            os.environ[GATEWAY_SENDFILE_ENV] = "0"
+
+        rng = np.random.default_rng(0)
+        sizes = ({"small": 4 << 10, "med": 32 << 10, "large": 64 << 10}
+                 if smoke else
+                 {"small": 16 << 10, "med": 256 << 10,
+                  "large": 1 << 20})
+        payloads = {name: rng.integers(0, 256, n, dtype=np.uint8)
+                    .tobytes() for name, n in sizes.items()}
+        # the cold tier: more bytes than the cache budget below, so
+        # these reads always pay fetch+verify on the server (the
+        # host-compute-bound half of the mix; hot reads are the
+        # loop-bound half)
+        n_cold = 2 if smoke else 24
+        cold_bytes = (16 << 10) if smoke else (256 << 10)
+        for i in range(n_cold):
+            payloads[f"cold{i}"] = rng.integers(
+                0, 256, cold_bytes, dtype=np.uint8).tobytes()
+        chunk_log2 = 12 if smoke else 16
+        chunk_bytes = 1 << chunk_log2
+
+        def make_cluster_obj(root: str) -> dict:
+            dirs = []
+            for i in range(5):
+                d = os.path.join(root, f"disk{i}")
+                os.makedirs(d, exist_ok=True)
+                dirs.append(d)
+            meta = os.path.join(root, "meta")
+            os.makedirs(meta, exist_ok=True)
+            return {
+                "destinations": [{"location": d} for d in dirs],
+                "metadata": {"type": "path", "format": "yaml",
+                             "path": meta},
+                # the reference's default geometry at gateway-friendly
+                # chunk sizes: ranges inside one chunk exercise the
+                # sendfile path, whole objects span chunks
+                "profiles": {"default": {"data": 3, "parity": 2,
+                                         "chunk_size": chunk_log2}},
+                # cache sized to hold the hot tier but NOT the cold
+                # tier: the mix stays genuinely mixed (hot reads serve
+                # from memory, cold reads re-fetch + re-verify)
+                "tunables": {"backend": "native",
+                             "cache_bytes": 2 << 20},
+            }
+
+        class MiniConn:
+            """Minimal raw-socket keep-alive HTTP/1.1 GET client (the
+            wrk role).  aiohttp's client costs more CPU per request
+            than the gateway spends serving a hot object — load driven
+            through it measures the generator, not the fleet.  This
+            parser handles exactly what the gateway sends (status line,
+            Content-Length-delimited bodies, body-less 304s) and keeps
+            the client's per-request cost far below the server's."""
+
+            def __init__(self, host: str, port: int):
+                self.host = host
+                self.port = port
+                self.reader = None
+                self.writer = None
+
+            async def open(self):
+                self.reader, self.writer = await asyncio.open_connection(
+                    self.host, self.port)
+                return self
+
+            async def get(self, path: str, extra: str = "") -> tuple:
+                """(status, body) over the persistent connection."""
+                self.writer.write(
+                    (f"GET {path} HTTP/1.1\r\n"
+                     f"Host: {self.host}\r\n{extra}\r\n").encode())
+                await self.writer.drain()
+                status_line = await self.reader.readline()
+                status = int(status_line.split(b" ", 2)[1])
+                length = 0
+                while True:
+                    line = await self.reader.readline()
+                    if line in (b"\r\n", b""):
+                        break
+                    if line[:15].lower() == b"content-length:":
+                        length = int(line[15:])
+                body = b""
+                if status not in (204, 304) and length:
+                    body = await self.reader.readexactly(length)
+                return status, body
+
+            async def close(self):
+                if self.writer is not None:
+                    self.writer.close()
+                    # bounded: closing a local socket
+                    try:
+                        await asyncio.wait_for(
+                            self.writer.wait_closed(), timeout=5)
+                    except (asyncio.TimeoutError, OSError):
+                        pass
+
+        async def run_leg(cluster_obj: dict, n_workers: int) -> dict:
+            sup = GatewaySupervisor(cluster_obj, "127.0.0.1", 0,
+                                    workers=n_workers,
+                                    ready_timeout=120.0)
+            await sup.start()
+            try:
+                url = f"http://127.0.0.1:{sup.port}"
+                connector = aiohttp.TCPConnector(limit=clients)
+                timeout = aiohttp.ClientTimeout(total=600)
+                async with aiohttp.ClientSession(
+                        connector=connector,
+                        timeout=timeout) as session:
+                    # warm pass, untimed: fills every worker's hot-tier
+                    # cache + sendfile memo (SO_REUSEPORT spreads the
+                    # connections) AND is the whole-object byte-
+                    # identity gate against the source payloads
+                    async def warm(i):
+                        for name in ("small", "med", "large"):
+                            r = await session.get(f"{url}/{name}")
+                            assert r.status == 200, r.status
+                            body = await r.read()
+                            assert body == payloads[name], \
+                                "warm byte identity"
+                    await asyncio.gather(*[warm(i)
+                                           for i in range(clients)])
+                    # identity pass, untimed: in-chunk ranges (the
+                    # sendfile path when on), a cross-chunk range and a
+                    # suffix (always reassembly), compared to the
+                    # numpy-oracle payload slices
+                    med = payloads["med"]
+                    for start, end in ((5, chunk_bytes - 1),
+                                       (chunk_bytes, 2 * chunk_bytes - 1),
+                                       (chunk_bytes // 2,
+                                        chunk_bytes + 99),
+                                       (len(med) - 50, len(med) - 1)):
+                        r = await session.get(
+                            f"{url}/med",
+                            headers={"Range": f"bytes={start}-{end}"})
+                        assert r.status == 206, r.status
+                        assert await r.read() == med[start:end + 1], \
+                            "range byte identity"
+                    r = await session.get(f"{url}/small")
+                    await r.read()
+                    etag = r.headers["ETag"]
+                    r = await session.get(f"{url}/med")
+                    await r.read()
+                    med_etag = r.headers["ETag"]
+
+                lat: dict = {"full": [], "range": [], "cond": [],
+                             "large": [], "cold": []}
+
+                async def one_client(ci: int) -> int:
+                    # ONE keep-alive raw connection per client; the
+                    # timed loop checks status + length only (identity
+                    # is pinned untimed above) so the SERVER stays the
+                    # measured resource
+                    done = 0
+                    crng = _random.Random(ci)
+                    conn = await MiniConn("127.0.0.1",
+                                          sup.port).open()
+                    try:
+                        for r_i in range(rounds):
+                            t0 = time.perf_counter()
+                            status, body = await conn.get("/small")
+                            lat["full"].append(time.perf_counter() - t0)
+                            assert status == 200
+                            assert len(body) == len(payloads["small"])
+                            done += 1
+
+                            start = crng.randrange(
+                                0, len(med) - chunk_bytes)
+                            start -= start % chunk_bytes
+                            end = start + chunk_bytes - 1
+                            t0 = time.perf_counter()
+                            status, body = await conn.get(
+                                "/med",
+                                f"Range: bytes={start}-{end}\r\n")
+                            lat["range"].append(
+                                time.perf_counter() - t0)
+                            assert status == 206
+                            assert len(body) == chunk_bytes
+                            done += 1
+
+                            t0 = time.perf_counter()
+                            status, body = await conn.get(
+                                "/small",
+                                f"If-None-Match: {etag}\r\n")
+                            lat["cond"].append(
+                                time.perf_counter() - t0)
+                            assert status == 304
+                            done += 1
+
+                            # cold tier: the set outsizes the cache.
+                            # Alternate two shapes — a range CROSSING a
+                            # chunk boundary (never sendfile-eligible:
+                            # the server fetches + SHA-verifies a whole
+                            # d-chunk part to ship 4 KiB), and a range
+                            # INSIDE one chunk (the sendfile fast path
+                            # when enabled: one verify, memoized, then
+                            # page-cache -> socket; with --no-sendfile
+                            # it pays the whole-part fetch instead —
+                            # THE on/off A/B class)
+                            name = f"cold{(ci + r_i * 7) % n_cold}"
+                            span = min(4096, chunk_bytes // 2)
+                            if (ci + r_i) % 2:
+                                start = chunk_bytes - span // 2
+                            else:
+                                start = chunk_bytes // 4
+                            t0 = time.perf_counter()
+                            status, body = await conn.get(
+                                f"/{name}",
+                                f"Range: bytes={start}-"
+                                f"{start + span - 1}\r\n")
+                            lat["cold"].append(
+                                time.perf_counter() - t0)
+                            assert status == 206
+                            assert len(body) == span
+                            done += 1
+
+                            if ci % 8 == 0:
+                                t0 = time.perf_counter()
+                                status, body = await conn.get("/large")
+                                lat["large"].append(
+                                    time.perf_counter() - t0)
+                                assert status == 200
+                                assert len(body) == \
+                                    len(payloads["large"])
+                                done += 1
+                    finally:
+                        await conn.close()
+                    return done
+
+                t0 = time.perf_counter()
+                counts = await asyncio.gather(
+                    *[one_client(i) for i in range(clients)])
+                wall = time.perf_counter() - t0
+
+                # unqueued phase: ONE sequential connection measures
+                # the per-request cost of a hot full-body read vs a
+                # 304 — the "repeat readers cost zero bytes" claim,
+                # uncontaminated by the saturation phase's queueing
+                seq = 20 if smoke else 100
+                conn = await MiniConn("127.0.0.1", sup.port).open()
+                try:
+                    seq_full: list = []
+                    seq_cond: list = []
+                    status, body = await conn.get("/med")
+                    assert status == 200  # hot again post-saturation
+                    for _ in range(seq):
+                        t0s = time.perf_counter()
+                        status, body = await conn.get("/med")
+                        seq_full.append(time.perf_counter() - t0s)
+                        assert status == 200
+                    for _ in range(seq):
+                        t0s = time.perf_counter()
+                        status, body = await conn.get(
+                            "/med", f"If-None-Match: {med_etag}\r\n")
+                        seq_cond.append(time.perf_counter() - t0s)
+                        assert status == 304
+                finally:
+                    await conn.close()
+
+                all_lat = sorted(v for vs in lat.values() for v in vs)
+                return {
+                    "requests": sum(counts),
+                    "wall": wall,
+                    "rps": sum(counts) / wall,
+                    "p50_ms": percentile(all_lat, 50) * 1e3,
+                    "p99_ms": percentile(all_lat, 99) * 1e3,
+                    "p999_ms": percentile(all_lat, 99.9) * 1e3,
+                    "full_p50_ms":
+                        percentile(sorted(seq_full), 50) * 1e3,
+                    "cond_p50_ms":
+                        percentile(sorted(seq_cond), 50) * 1e3,
+                }
+            finally:
+                await sup.stop()
+
+        async def run() -> list:
+            results = []
+            with contextlib.ExitStack() as stack:
+                root = stack.enter_context(
+                    tempfile.TemporaryDirectory())
+                cluster_obj = make_cluster_obj(root)
+                cluster = Cluster.from_obj(cluster_obj)
+                profile = cluster.get_profile(None)
+                for name, data in payloads.items():
+                    await cluster.write_file(
+                        name, aio.BytesReader(data), profile)
+                await cluster.tunables.location_context().aclose()
+                for n_workers in worker_counts:
+                    results.append(
+                        (n_workers,
+                         await run_leg(cluster_obj, n_workers)))
+            return results
+
+        results = asyncio.run(run())
+        base_rps = results[0][1]["rps"]
+        for n_workers, res in results:
+            cond_speedup = (res["full_p50_ms"] / res["cond_p50_ms"]
+                            if res["cond_p50_ms"] > 0 else 0.0)
+            print(f"# config 9: workers={n_workers} clients={clients} "
+                  f"rounds={rounds} sendfile="
+                  f"{'off' if no_sendfile else 'on'}: "
+                  f"{res['requests']} reqs in {res['wall']:.2f}s = "
+                  f"{res['rps']:.0f} RPS | p50/p99/p999 "
+                  f"{res['p50_ms']:.1f}/{res['p99_ms']:.1f}/"
+                  f"{res['p999_ms']:.1f} ms | sequential hot full p50 "
+                  f"{res['full_p50_ms']:.2f} ms vs 304 p50 "
+                  f"{res['cond_p50_ms']:.2f} ms ({cond_speedup:.1f}x)",
+                  file=sys.stderr)
+            print(json.dumps({
+                "metric": (metric_base + f"_w{n_workers}"
+                           + ("_nosendfile" if no_sendfile else "")
+                           + ("_smoke" if smoke else "")),
+                "value": round(res["rps"], 1),
+                "unit": "req/s",
+                # the A/B this config exists for: this leg's RPS over
+                # the sweep's first (single-worker) leg
+                "vs_baseline": round(res["rps"] / base_rps, 2)
+                if base_rps > 0 else 0.0,
+                "workers": n_workers,
+                "clients": clients,
+                "requests": res["requests"],
+                "p50_ms": round(res["p50_ms"], 2),
+                "p99_ms": round(res["p99_ms"], 2),
+                "p999_ms": round(res["p999_ms"], 2),
+                "hot_full_p50_ms": round(res["full_p50_ms"], 3),
+                "cond_304_p50_ms": round(res["cond_p50_ms"], 3),
+                "cond_304_speedup": round(cond_speedup, 2),
+                "host_cores": nproc(),
+            }))
+    # lint: broad-except-ok the driver contract (ONE parseable JSON
+    # line, always) outranks the traceback; the error text carries it
+    except Exception as err:
+        print(json.dumps({
+            "metric": metric_base, "value": 0.0, "unit": "req/s",
+            "vs_baseline": 0.0,
+            "error": f"{type(err).__name__}: {err}",
+        }))
+        sys.exit(3)
+
+
 def bench_small_objects(argv=()) -> None:
     """BASELINE.md config 4's compute core: many concurrent small-object
     encodes (d=8 p=3, 4 MiB objects => [1, 8, S] batches) coalescing
@@ -1274,15 +1674,17 @@ if __name__ == "__main__":
                    "4": lambda: bench_small_objects(sys.argv),
                    "6": lambda: bench_hot_read(sys.argv),
                    "7": lambda: bench_gateway_put(sys.argv),
-                   "8": lambda: bench_hedged_read(sys.argv)}
+                   "8": lambda: bench_hedged_read(sys.argv),
+                   "9": lambda: bench_gateway_scaleout(sys.argv)}
         idx = sys.argv.index("--config") + 1
         which = sys.argv[idx] if idx < len(sys.argv) else ""
         if which not in configs:
-            print(f"usage: bench.py [--config {{1,2,3,4,6,7,8}}] — the "
+            print(f"usage: bench.py [--config {{1,2,3,4,6,7,8,9}}] — the "
                   f"device kernel metric (configs 2+3's compute core) is "
                   f"the default no-arg run (got {which!r}); 6 is the "
                   f"hot-read cache A/B, 7 the gateway PUT ingest A/B, "
-                  f"8 the hedged-read tail-latency A/B (all CPU-only)",
+                  f"8 the hedged-read tail-latency A/B, 9 the gateway "
+                  f"scale-out multi-worker A/B (all CPU-only)",
                   file=sys.stderr)
             sys.exit(2)
         configs[which]()

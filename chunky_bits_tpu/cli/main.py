@@ -112,6 +112,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--min-put-rate", type=int, default=256,
                    help="Abort PUTs averaging below this many bytes/sec "
                         "after a grace period; 0 disables (default 256)")
+    p.add_argument("--max-concurrent-gets", type=int, default=256,
+                   help="Shed GETs beyond this many in flight with "
+                        "503 + Retry-After (per worker); 0 means "
+                        "unbounded (default 256)")
+    p.add_argument("--workers", type=int, default=None,
+                   help="Serve with N pre-forked SO_REUSEPORT worker "
+                        "processes (default: "
+                        "$CHUNKY_BITS_TPU_GATEWAY_WORKERS, else 1)")
 
     p = sub.add_parser("ls", help="List the files in a cluster directory")
     p.add_argument("-r", "--recursive", action="store_true")
@@ -277,7 +285,9 @@ async def _run_command(args, config) -> int:
         await serve(cluster, host or "127.0.0.1", int(port),
                     max_put_bytes=args.max_put_size,
                     max_concurrent_puts=args.max_concurrent_puts,
-                    min_put_rate=args.min_put_rate)
+                    min_put_rate=args.min_put_rate,
+                    max_concurrent_gets=args.max_concurrent_gets,
+                    workers=args.workers)
     elif cmd == "ls":
         target = ClusterLocation.parse(args.target)
         if args.recursive:

@@ -73,6 +73,21 @@ READ_RETRIES_ENV = "CHUNKY_BITS_TPU_READ_RETRIES"
 #: routed through here so the knob is discoverable and CB102-clean)
 STAGGER_SECONDS_ENV = "CHUNKY_BITS_TPU_STAGGER_SECONDS"
 
+#: gateway worker-process count (gateway/workers.py): N > 1 pre-forks
+#: N SO_REUSEPORT serving processes, each with its own loop, host
+#: pipeline, chunk cache, and health scoreboard.  A deployment knob,
+#: not a cluster property, so it is env/CLI-only (no YAML field); the
+#: ``serve --workers`` flag wins.  Read at serve start.
+GATEWAY_WORKERS_ENV = "CHUNKY_BITS_TPU_GATEWAY_WORKERS"
+
+#: zero-copy local-chunk streaming on the gateway GET path
+#: (gateway/http.py): ranges covered by one verified whole chunk on a
+#: local Location stream via loop.sendfile, bypassing reassembly.
+#: Default on (bench --config 9 is the A/B; BASELINE.md records it);
+#: set to a falsy value to force every GET through the reassembly
+#: path.  Read at app build.
+GATEWAY_SENDFILE_ENV = "CHUNKY_BITS_TPU_GATEWAY_SENDFILE"
+
 #: opt-in runtime concurrency sanitizer (analysis/sanitizer.py):
 #: event-loop stall watchdog, task-leak registry, host-pipeline handoff
 #: checks.  Off by default (and force-disabled by bench.py — the
@@ -156,6 +171,32 @@ def sanitize_enabled() -> bool:
     even loads the instrumentation module (pinned by
     tests/test_sanitizer.py's zero-overhead check)."""
     return env_flag(SANITIZE_ENV)
+
+
+def gateway_workers(*, default: int = 1) -> int:
+    """Requested gateway worker-process count from
+    ``$CHUNKY_BITS_TPU_GATEWAY_WORKERS``; unset/malformed/non-positive
+    reads as ``default`` (1 = the classic single-process gateway).
+    Lenient like ``host_threads`` — a scale knob can only *tune*, never
+    crash, serve startup.  The ``http-gateway --workers`` CLI flag wins
+    over the env var."""
+    raw = os.environ.get(GATEWAY_WORKERS_ENV, "")
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def gateway_sendfile(*, default: bool = True) -> bool:
+    """True when the gateway may stream verified whole local chunks via
+    ``loop.sendfile`` (gateway/http.py).  Default on — measured in
+    bench --config 9 (BASELINE.md); a falsy
+    ``$CHUNKY_BITS_TPU_GATEWAY_SENDFILE`` forces the reassembly path
+    everywhere (e.g. storage shared with external truncating writers,
+    the same caveat as ``CHUNKY_BITS_TPU_NO_MMAP``).  Read at app
+    build."""
+    return env_flag(GATEWAY_SENDFILE_ENV, default=default)
 
 
 def stagger_seconds(*, default: float = 0.1) -> float:

@@ -105,6 +105,13 @@ class ChunkCache:
     def size_bytes(self) -> int:
         return self._size
 
+    def contains(self, digest: bytes) -> bool:
+        """Presence probe that neither counts a hit nor freshens LRU —
+        for observers (the gateway access log's hit/miss tag, the
+        sendfile-eligibility check) that must not skew the hit rate or
+        the eviction order the serving reads establish."""
+        return digest in self._entries
+
     def get(self, digest: bytes) -> Optional[bytes]:
         """The verified bytes for ``digest``, freshened to MRU, or None.
         A miss is not counted here — only a fetch that actually starts

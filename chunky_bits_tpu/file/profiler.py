@@ -16,6 +16,73 @@ from dataclasses import dataclass
 from typing import Optional
 
 
+def percentile(sorted_values: list, q: float) -> float:
+    """Linear-interpolated percentile of an ascending-sorted list (the
+    numpy 'linear' method, stdlib-only so this module stays
+    dependency-free).  THE percentile implementation for the serving
+    plane: the gateway access log's stats and bench --config 9's
+    client-side latency report both call it, so production p99s and
+    bench p99s are computed by the same code."""
+    if not sorted_values:
+        return 0.0
+    if len(sorted_values) == 1:
+        return float(sorted_values[0])
+    pos = (len(sorted_values) - 1) * (q / 100.0)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_values) - 1)
+    frac = pos - lo
+    return float(sorted_values[lo]) * (1 - frac) \
+        + float(sorted_values[hi]) * frac
+
+
+@dataclass
+class RequestLog:
+    """One gateway request (the access-log record): what was asked,
+    what was answered, how long it took and where the bytes came from.
+    ``source`` is the serving path: "cache" (all chunks pre-verified in
+    the read cache), "sendfile" (zero-copy local whole-chunk stream),
+    "cond" (304, zero body bytes), "meta" (HEAD — headers only),
+    "store" (fetch+verify+reassemble), or "-" (errors / PUTs)."""
+
+    method: str
+    path: str
+    status: int
+    nbytes: int
+    duration: float  # seconds of wall time
+    source: str
+
+
+@dataclass
+class RequestStats:
+    """Aggregate of the drained access log (percentiles via
+    :func:`percentile`, shared with bench --config 9)."""
+
+    count: int
+    errors: int  # status >= 500
+    total_bytes: int
+    p50_ms: float
+    p99_ms: float
+    p999_ms: float
+
+    def __str__(self) -> str:
+        return (f"Requests<n={self.count} errors={self.errors} "
+                f"bytes={self.total_bytes} p50={self.p50_ms:.2f}ms "
+                f"p99={self.p99_ms:.2f}ms p999={self.p999_ms:.2f}ms>")
+
+
+def request_stats(entries: list) -> RequestStats:
+    """Roll a list of :class:`RequestLog` into :class:`RequestStats`."""
+    lat = sorted(e.duration for e in entries)
+    return RequestStats(
+        count=len(entries),
+        errors=sum(1 for e in entries if e.status >= 500),
+        total_bytes=sum(e.nbytes for e in entries),
+        p50_ms=percentile(lat, 50) * 1000.0,
+        p99_ms=percentile(lat, 99) * 1000.0,
+        p999_ms=percentile(lat, 99.9) * 1000.0,
+    )
+
+
 @dataclass
 class ResultLog:
     kind: str  # "read" | "write"
@@ -46,6 +113,9 @@ class Profiler:
         # the diagnosable trail the anonymous `except LocationError:
         # continue` used to swallow
         self._location_failures: list[tuple[object, str]] = []
+        # gateway access-log records (one per HTTP request) — the
+        # serving-plane analogue of the per-I/O entries above
+        self._requests: list[RequestLog] = []
 
     def attach_cache(self, cache) -> None:
         """Register a chunk cache so its hit/miss/eviction/singleflight
@@ -103,6 +173,23 @@ class Profiler:
             out, self._location_failures = self._location_failures, []
         return out
 
+    def log_request(self, method: str, path: str, status: int,
+                    nbytes: int, duration: float, source: str) -> None:
+        """One gateway request completed (gateway/http.py's access-log
+        middleware): the same counters production logs print feed the
+        report's :class:`RequestStats`, so serving percentiles come
+        from one code path whether read off a log line or a bench
+        run."""
+        entry = RequestLog(method, path, status, nbytes, duration,
+                           source)
+        with self._lock:
+            self._requests.append(entry)
+
+    def drain_requests(self) -> list[RequestLog]:
+        with self._lock:
+            out, self._requests = self._requests, []
+        return out
+
     def log_read(self, ok: bool, error: Optional[str], location,
                  length: int, start_time: float) -> None:
         entry = ResultLog("read", ok, error, location, length,
@@ -126,12 +213,13 @@ class Profiler:
 class ProfileReport:
     def __init__(self, entries: list[ResultLog], cache_stats: list = (),
                  pipeline_stats: list = (), health_stats: list = (),
-                 location_failures: list = ()):
+                 location_failures: list = (), requests: list = ()):
         self.entries = entries
         self.cache_stats = list(cache_stats)
         self.pipeline_stats = list(pipeline_stats)
         self.health_stats = list(health_stats)
         self.location_failures = list(location_failures)
+        self.requests = list(requests)
 
     def _avg(self, kind: str) -> Optional[float]:
         durations = [e.duration for e in self.entries if e.kind == kind]
@@ -168,6 +256,8 @@ class ProfileReport:
             base += f" {stats}"
         for stats in self.health_stats:
             base += f" {stats}"
+        if self.requests:
+            base += f" {request_stats(self.requests)}"
         if self.location_failures:
             shown = "; ".join(f"{loc}: {err}"
                               for loc, err in self.location_failures[:8])
@@ -189,7 +279,8 @@ class ProfileReporter:
                              self._profiler.cache_stats(),
                              self._profiler.pipeline_stats(),
                              self._profiler.health_stats(),
-                             self._profiler.drain_location_failures())
+                             self._profiler.drain_location_failures(),
+                             self._profiler.drain_requests())
 
 
 def new_profiler() -> tuple[Profiler, ProfileReporter]:

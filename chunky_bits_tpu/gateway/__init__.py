@@ -1,3 +1,9 @@
-"""Streaming HTTP object gateway (the reference's src/http.rs)."""
+"""Streaming HTTP object gateway (the reference's src/http.rs), plus
+the multi-worker serving plane (gateway/workers.py)."""
 
-from chunky_bits_tpu.gateway.http import make_app, parse_http_range, serve  # noqa: F401
+from chunky_bits_tpu.gateway.http import (  # noqa: F401
+    file_ref_etag,
+    make_app,
+    parse_http_range,
+    serve,
+)

@@ -10,20 +10,56 @@ Deviations, documented: the reference's ``bytes=a-b`` handler reads
 ``b - a`` bytes (an off-by-one against RFC 9110 inclusive ranges,
 http.rs:40-42) and emits a Content-Range without the ``bytes `` unit; both
 are corrected here.
+
+Serving-plane extensions beyond the reference (the scale-out surface —
+src/http.rs has none of these):
+
+- **Conditional GETs.**  Every GET/HEAD answer carries a strong ``ETag``
+  derived from the file reference (the content-addressed chunk digests:
+  same bytes => same reference => same tag); ``If-None-Match`` hits
+  answer 304 with zero body bytes, so repeat readers of unchanged
+  objects cost one metadata read.
+- **Zero-copy local-chunk streaming.**  A requested range covered by ONE
+  data chunk with a verified local replica streams via ``loop.sendfile``
+  (page cache -> socket, no userspace copy), bypassing the whole
+  fetch/verify/reassemble pipeline; verification digests are memoized
+  per (path, size, mtime_ns) — chunk files are content-addressed and
+  replaced only by atomic rename, so a stale memo entry is impossible
+  without an mtime change.  ``tunables.gateway_sendfile`` /
+  ``$CHUNKY_BITS_TPU_GATEWAY_SENDFILE`` disables it (bench --config 9 is
+  the A/B).
+- **Admission control.**  In-flight GET bodies are bounded
+  (``max_concurrent_gets``); excess requests get an immediate
+  503 + ``Retry-After`` instead of queueing into memory — the read-side
+  sibling of the PUT semaphore below.
+- **Access log.**  One structured line per request (method, path,
+  status, bytes, wall ms, serving source) through the app's
+  ``Profiler.log_request``, so production logs and bench --config 9
+  percentiles come from the same counters
+  (file/profiler.py::request_stats).
+
+Multi-worker serving (``serve(..., workers=N)``) lives in
+gateway/workers.py: N pre-forked SO_REUSEPORT processes, each running
+this module's app on its own loop.
 """
 
 from __future__ import annotations
 
 import asyncio
 import contextlib
+import hashlib
+import json
 import logging
+import os
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from aiohttp import web
 
 from chunky_bits_tpu.cluster import Cluster
 from chunky_bits_tpu.errors import ChunkyBitsError, MetadataReadError
+from chunky_bits_tpu.file.file_reference import FileReference
+from chunky_bits_tpu.file.profiler import Profiler
 from chunky_bits_tpu.utils import aio
 
 log = logging.getLogger("chunky_bits_tpu.gateway")
@@ -40,6 +76,26 @@ DEFAULT_MAX_CONCURRENT_PUTS = 32
 #: 0 disables the floor.
 DEFAULT_MIN_PUT_RATE = 256
 _RATE_GRACE_SECONDS = 30.0
+
+#: default bound on in-flight GET bodies per worker; the 257th
+#: concurrent reader gets 503 + Retry-After instead of a queue slot.
+#: Unlike the PUT semaphore (which queues — an ingest carries client
+#: bytes that would be lost), reads are idempotent and retryable, so
+#: shedding beats buffering.  <=0 = unbounded.
+DEFAULT_MAX_CONCURRENT_GETS = 256
+
+#: Retry-After seconds on a shed GET — short: a slot frees as soon as
+#: any in-flight body finishes
+_RETRY_AFTER_SECONDS = "1"
+
+#: bound on the (path, size, mtime_ns) -> verified-digest memo feeding
+#: the sendfile fast path; oldest entries drop past this (FIFO — a
+#: dropped entry only costs one re-verify)
+_VERIFIED_MEMO_ENTRIES = 4096
+
+#: the app's request-log profiler (``make_app`` stores it here; tests
+#: and bench read percentiles off it)
+PROFILER_KEY: web.AppKey = web.AppKey("cb_profiler", Profiler)
 
 
 class HttpRangeError(ValueError):
@@ -121,15 +177,116 @@ def parse_http_range(s: str):
     raise HttpRangeError("no range specified")
 
 
+def file_ref_etag(file_ref: FileReference) -> str:
+    """Strong ETag for a file reference: sha256 over its CONTENT
+    identity — length, content type, and every chunk's content digest
+    per part — quoted per RFC 9110.  Locations are deliberately
+    excluded: a resilver or rebalance rewrites placement for unchanged
+    bytes, and a placement change must not invalidate every client's
+    cached validator (nor let two workers with differently-aged
+    metadata caches serve different tags for the same bytes).  Chunk
+    digests are content-addressed, so equal tags imply byte-identical
+    objects across workers and restarts.  Memoized on the ref object:
+    the cluster's metadata cache hands the same parsed instance to
+    every hot GET."""
+    cached = getattr(file_ref, "_gateway_etag", None)
+    if cached is not None:
+        return cached
+    canon = json.dumps({
+        "length": file_ref.length,
+        "content_type": file_ref.content_type,
+        "compression": file_ref.compression,
+        "parts": [
+            {"chunksize": part.chunksize,
+             "data": [str(c.hash) for c in part.data],
+             "parity": [str(c.hash) for c in part.parity]}
+            for part in file_ref.parts
+        ],
+    }, sort_keys=True, separators=(",", ":"))
+    etag = f'"{hashlib.sha256(canon.encode()).hexdigest()[:32]}"'
+    file_ref._gateway_etag = etag
+    return etag
+
+
+def _if_none_match_hits(header: Optional[str], etag: str) -> bool:
+    """True when an ``If-None-Match`` header matches ``etag`` (RFC 9110
+    §13.1.2: ``*`` matches anything; weak comparison, so a ``W/`` prefix
+    on the client's copy still hits)."""
+    if header is None:
+        return False
+    for token in header.split(","):
+        token = token.strip()
+        if token == "*":
+            return True
+        if token.startswith("W/"):
+            token = token[2:]
+        if token == etag:
+            return True
+    return False
+
+
+def _covering_chunk(file_ref: FileReference, seek: int, length: int):
+    """(chunk, chunksize, offset_in_chunk) when the byte span
+    [seek, seek+length) lies inside ONE data chunk of one part — the
+    precondition for serving it straight off a local chunk file — else
+    None.  Parity chunks never qualify (their bytes are not file
+    bytes), nor do spans crossing a chunk or part boundary."""
+    part_off = 0
+    for part in file_ref.parts:
+        part_len = part.len_bytes()
+        if seek < part_off + part_len:
+            if seek + length > part_off + part_len:
+                return None  # spans parts
+            local = seek - part_off
+            csize = part.chunksize
+            if csize <= 0:
+                return None
+            idx = local // csize
+            if idx >= len(part.data):
+                return None
+            if local + length > (idx + 1) * csize:
+                return None  # spans chunks
+            return part.data[idx], csize, local - idx * csize
+        part_off += part_len
+    return None
+
+
+def _sha256_path(path: str) -> bytes:
+    """Streaming sha256 of a file (the sendfile verify fallback when
+    the native fused hasher is unavailable); runs on the host
+    pipeline's workers, never the loop."""
+    from chunky_bits_tpu.file.hashing import Sha256Hash
+
+    with open(path, "rb") as f:
+        return Sha256Hash.from_reader(f).digest
+
+
 def make_app(cluster: Cluster,
              max_put_bytes: Optional[int] = None,
              max_concurrent_puts: int = DEFAULT_MAX_CONCURRENT_PUTS,
-             min_put_rate: int = DEFAULT_MIN_PUT_RATE
+             min_put_rate: int = DEFAULT_MIN_PUT_RATE,
+             max_concurrent_gets: int = DEFAULT_MAX_CONCURRENT_GETS,
+             sendfile: Optional[bool] = None,
+             profiler: Optional[Profiler] = None
              ) -> web.Application:
     # <=0 means unbounded, like the reference's ingest (and matching
     # min_put_rate's "0 disables" convention)
     put_sem = (asyncio.Semaphore(max_concurrent_puts)
                if max_concurrent_puts > 0 else contextlib.nullcontext())
+
+    # sendfile defaults from the tunable, read here at app build (the
+    # gateway's first-use moment, like every other knob)
+    if sendfile is None:
+        from chunky_bits_tpu.cluster.tunables import gateway_sendfile
+
+        sendfile = gateway_sendfile()
+
+    # the app's own profiler collects the per-request access log; the
+    # cluster's serve-path counters (cache, health) ride along so one
+    # report shows the whole serving picture
+    if profiler is None:
+        profiler = Profiler()
+    profiler.attach_health(cluster.health_scoreboard())
 
     # PUT ingest compute (per-shard SHA-256 + per-stripe GF encode) runs
     # on the cluster's host pipeline workers, so the event loop's socket
@@ -137,8 +294,9 @@ def make_app(cluster: Cluster,
     # sharing one thread with it.  Resolve (and thereby spawn) the
     # workers now: the first request shouldn't pay the warm-up, and a
     # misconfigured tunables.host_threads should fail at serve start,
-    # not mid-ingest.
-    cluster.host_pipeline()
+    # not mid-ingest.  The read path's verify hops (incl. the sendfile
+    # digest check) draw from the same pipeline.
+    pipe = cluster.host_pipeline()
 
     # Every GET/PUT of this app feeds the cluster's ONE location-health
     # scoreboard (cluster/health.py) through the shared LocationContext
@@ -147,6 +305,127 @@ def make_app(cluster: Cluster,
     # batcher.  On failures the per-node table goes to the log so a
     # degraded cluster is diagnosable from the gateway side alone.
     health = cluster.health_scoreboard()
+
+    # in-flight GET bodies (admission control); a plain counter — all
+    # bookkeeping happens on the app's loop
+    gets_in_flight = {"now": 0}
+
+    # (path) -> (size, mtime_ns) of chunk files whose digest verified,
+    # LRU-bounded; keyed state is per-app (= per worker process), like
+    # the chunk cache — see gateway/workers.py on why serving state is
+    # partitioned, not shared, across workers
+    verified_memo: dict[str, tuple[int, int]] = {}
+
+    async def _verify_local_chunk(chunk, location, chunksize: int
+                                  ) -> bool:
+        """True when the local chunk file at ``location`` currently
+        holds exactly the content-addressed bytes ``chunk`` names.
+        Full-file digest on first sight; (size, mtime_ns) memo
+        afterwards — atomic-rename publication means same path + same
+        mtime_ns + same size is the same inode content."""
+        from chunky_bits_tpu.file.file_part import _hash_local_fused
+
+        path = location.target
+        try:
+            st = await asyncio.to_thread(os.stat, path)
+        except OSError:
+            return False
+        if st.st_size != chunksize:
+            return False
+        if verified_memo.get(path) == (st.st_size, st.st_mtime_ns):
+            return True
+        cx = cluster.tunables.location_context()
+        digest = await _hash_local_fused(chunk, location, cx, pipe)
+        if digest is None:
+            try:
+                digest = await pipe.run(
+                    "verify", lambda: _sha256_path(path),
+                    nbytes=chunksize)
+            except OSError:
+                return False
+        if digest != chunk.hash.value.digest:
+            # corrupt replica: a demerit for the node, and the generic
+            # read path (which falls through / reconstructs) takes over
+            health.record(location, False)
+            return False
+        verified_memo[path] = (st.st_size, st.st_mtime_ns)
+        while len(verified_memo) > _VERIFIED_MEMO_ENTRIES:
+            verified_memo.pop(next(iter(verified_memo)))
+        return True
+
+    async def _sendfile_response(request: web.Request, status: int,
+                                 headers: dict, path: str,
+                                 offset: int, count: int
+                                 ) -> Optional[web.StreamResponse]:
+        """Stream ``count`` bytes of ``path`` from ``offset`` via
+        ``loop.sendfile`` (the aiohttp FileResponse pattern: prepare,
+        sendfile on the raw transport, write_eof).  Returns None when
+        the file cannot be opened (caller falls back to reassembly);
+        after headers are on the wire, socket-level failures abort the
+        connection exactly like the reassembly path's mid-stream
+        abort."""
+        try:
+            f = await asyncio.to_thread(open, path, "rb")
+        except OSError:
+            return None
+        try:
+            resp = web.StreamResponse(status=status, headers=headers)
+            await resp.prepare(request)
+            transport = request.transport
+            if transport is None:  # client already gone
+                return resp
+            loop = asyncio.get_running_loop()
+            try:
+                try:
+                    await loop.sendfile(transport, f, offset, count)
+                except NotImplementedError:
+                    # no OS sendfile on this transport: bounded chunked
+                    # copy through the normal writer
+                    await asyncio.to_thread(f.seek, offset)
+                    remaining = count
+                    while remaining > 0:
+                        data = await asyncio.to_thread(
+                            f.read, min(1 << 20, remaining))
+                        if not data:
+                            break
+                        remaining -= len(data)
+                        await resp.write(data)
+            except (ConnectionError, OSError) as err:
+                # the file side verified before we got here, so this is
+                # the socket: abort the connection like the reassembly
+                # path does mid-stream
+                log.error("GET %s sendfile aborted: %s",
+                          request.path, err)
+                resp.force_close()
+                if request.transport is not None:
+                    request.transport.close()
+                return resp
+            await resp.write_eof()
+            return resp
+        finally:
+            await asyncio.to_thread(f.close)
+
+    def _serve_source(file_ref: FileReference, cache,
+                      seek: int, length: int) -> str:
+        """Access-log tag for a reassembly-path read: "cache" when
+        every data chunk of every part the span touches is already in
+        the read cache (contains() probes — no hit-count skew), else
+        "store"."""
+        if cache is None:
+            return "store"
+        end = seek + length
+        part_off = 0
+        for part in file_ref.parts:
+            part_len = part.len_bytes()
+            if part_off < end and part_off + part_len > seek:
+                for chunk in part.data:
+                    key = chunk.cache_key()
+                    if key is None or not cache.contains(key):
+                        return "store"
+            part_off += part_len
+            if part_off >= end:
+                break
+        return "cache"
 
     async def handle_get(request: web.Request) -> web.StreamResponse:
         path = request.match_info["path"]
@@ -159,6 +438,14 @@ def make_app(cluster: Cluster,
             # node URLs / filesystem paths untrusted clients must not see
             log.error("GET %s failed: %s", path, err)
             return web.Response(status=500, text="error: internal error\n")
+        etag = file_ref_etag(file_ref)
+        # conditional GET: evaluated before Range (RFC 9110 §13.2.2) —
+        # a matching validator answers 304 with zero body bytes
+        if _if_none_match_hits(request.headers.get("If-None-Match"),
+                               etag):
+            request["cb_source"] = "cond"
+            return web.Response(status=304, headers={"ETag": etag})
+        total = file_ref.len_bytes()
         # the cluster's serve-path builder: per-loop shared reconstruct
         # batcher (concurrent degraded GETs coalesce their decode
         # dispatches) and, when `tunables.cache_bytes` is set, the
@@ -167,7 +454,7 @@ def make_app(cluster: Cluster,
         # seek/take trim below happens at the edge, after the cache.
         builder = cluster.file_read_builder(file_ref)
         status = 200
-        headers = {}
+        headers = {"ETag": etag}
         range_header = request.headers.get("Range")
         parsed = None
         if range_header is not None:
@@ -179,7 +466,6 @@ def make_app(cluster: Cluster,
                 # ranges.
                 parsed = None
         if parsed is not None:
-            total = file_ref.len_bytes()
             if parsed[0] == "range":
                 _, start, end = parsed
                 builder = builder.with_seek(start).with_take(end - start + 1)
@@ -192,19 +478,82 @@ def make_app(cluster: Cluster,
                 length = min(parsed[1], total)
                 builder = builder.with_seek(total - length).with_take(length)
             if builder.len_bytes() == 0:
-                return web.Response(status=416)
+                # unsatisfiable: RFC 9110 §14.4 — Content-Range carries
+                # the selected representation's length so the client
+                # can re-range without a probe request
+                return web.Response(
+                    status=416,
+                    headers={"Content-Range": f"bytes */{total}",
+                             "ETag": etag})
             seek = builder.seek
             end_excl = seek + builder.len_bytes()
             headers["Content-Range"] = \
                 f"bytes {seek}-{end_excl - 1}/{total}"
             status = 206
-        headers["Content-Length"] = str(builder.len_bytes())
+        length = builder.len_bytes()
+        headers["Content-Length"] = str(length)
         if file_ref.content_type:
             headers["Content-Type"] = file_ref.content_type
+        if request.method == "HEAD":
+            # shares the whole resolution path above (ETag, ranges,
+            # 416, Content-Length/Type) but never touches chunk bytes
+            request["cb_source"] = "meta"
+            resp = web.StreamResponse(status=status, headers=headers)
+            await resp.prepare(request)
+            return resp
+        # Admission control, HERE and not at handler entry: only
+        # in-flight GET *bodies* occupy slots, so HEAD, 304
+        # revalidations, 404s and 416s — all body-free and cheap — are
+        # always answered even at the bound.  Shed, don't queue: an
+        # immediate 503 with Retry-After keeps worker memory bounded
+        # under a client storm and tells well-behaved clients exactly
+        # what to do.
+        if (max_concurrent_gets > 0
+                and gets_in_flight["now"] >= max_concurrent_gets):
+            return web.Response(
+                status=503, text="error: too many in-flight reads\n",
+                headers={"Retry-After": _RETRY_AFTER_SECONDS})
+        gets_in_flight["now"] += 1
+        try:
+            return await _serve_get_body(request, path, file_ref,
+                                         builder, status, headers,
+                                         length)
+        finally:
+            gets_in_flight["now"] -= 1
+
+    async def _serve_get_body(request: web.Request, path: str,
+                              file_ref: FileReference, builder,
+                              status: int, headers: dict, length: int
+                              ) -> web.StreamResponse:
+        cache = builder.cache
+        # zero-copy fast path: a span inside ONE data chunk with a
+        # verified local replica streams straight from the page cache.
+        # A chunk already in the read cache is served from memory by
+        # the generic path instead (cheaper than re-stating the file).
+        if sendfile and length > 0:
+            covered = _covering_chunk(file_ref, builder.seek, length)
+            if covered is not None:
+                chunk, csize, off = covered
+                key = chunk.cache_key()
+                in_cache = (cache is not None and key is not None
+                            and cache.contains(key))
+                if not in_cache:
+                    for location in chunk.locations:
+                        if not location.is_local() \
+                                or location.range.is_specified():
+                            continue
+                        if await _verify_local_chunk(chunk, location,
+                                                     csize):
+                            resp = await _sendfile_response(
+                                request, status, headers,
+                                location.target, off, length)
+                            if resp is not None:
+                                request["cb_source"] = "sendfile"
+                                return resp
+        request["cb_source"] = _serve_source(file_ref, cache,
+                                             builder.seek, length)
         resp = web.StreamResponse(status=status, headers=headers)
         await resp.prepare(request)
-        if request.method == "HEAD":
-            return resp
         try:
             async for chunk in builder.stream():
                 await resp.write(chunk)
@@ -268,7 +617,41 @@ def make_app(cluster: Cluster,
                 return put_reject(500, "error: internal error\n")
         return web.Response(status=200)
 
-    app = web.Application()
+    @web.middleware
+    async def access_log(request: web.Request, handler
+                         ) -> web.StreamResponse:
+        """One structured record per request — the log line operators
+        grep and the counters bench --config 9 reports are the same
+        numbers (Profiler.log_request -> request_stats).  ``bytes`` is
+        the declared body length: an aborted stream still logs the
+        length it promised (the abort itself is logged separately)."""
+        start = time.monotonic()
+        status = 500
+        nbytes = 0
+        try:
+            resp = await handler(request)
+            status = resp.status
+            if request.method != "HEAD" and status < 300:
+                nbytes = resp.content_length or 0
+            return resp
+        except web.HTTPException as err:
+            # the router answers unroutable methods (405 etc.) by
+            # raising; log the status the client actually sees, not a
+            # phantom 500 that would inflate error-rate stats
+            status = err.status
+            raise
+        finally:
+            duration = time.monotonic() - start
+            source = request.get("cb_source", "-")
+            profiler.log_request(request.method, request.path, status,
+                                 nbytes, duration, source)
+            log.info(
+                "req method=%s path=%s status=%d bytes=%d ms=%.2f "
+                "source=%s", request.method, request.path, status,
+                nbytes, duration * 1000.0, source)
+
+    app = web.Application(middlewares=[access_log])
+    app[PROFILER_KEY] = profiler
     app.router.add_get("/{path:.*}", handle_get)  # also serves HEAD
     app.router.add_put("/{path:.*}", handle_put)
     return app
@@ -278,11 +661,37 @@ async def serve(cluster: Cluster, host: str = "127.0.0.1",
                 port: int = 8000,
                 max_put_bytes: Optional[int] = None,
                 max_concurrent_puts: int = DEFAULT_MAX_CONCURRENT_PUTS,
-                min_put_rate: int = DEFAULT_MIN_PUT_RATE
+                min_put_rate: int = DEFAULT_MIN_PUT_RATE,
+                max_concurrent_gets: int = DEFAULT_MAX_CONCURRENT_GETS,
+                workers: Optional[int] = None,
+                reuse_port: bool = False,
+                on_ready: Optional[Callable[[int], None]] = None
                 ) -> None:
     """Bind and serve until cancelled (ctrl-c graceful shutdown,
-    main.rs:474-485)."""
-    from chunky_bits_tpu.cluster.tunables import sanitize_enabled
+    main.rs:474-485).
+
+    ``workers`` (None = the ``tunables.gateway_workers`` env default,
+    normally 1) > 1 delegates to gateway/workers.py: N pre-forked
+    SO_REUSEPORT processes, each running this single-process serve with
+    ``reuse_port=True``.  ``on_ready`` fires with the bound port once
+    the listener accepts connections (the worker readiness handshake;
+    also handy for tests)."""
+    from chunky_bits_tpu.cluster.tunables import (gateway_workers,
+                                                  sanitize_enabled)
+
+    if workers is None:
+        workers = gateway_workers()
+    if workers > 1:
+        from chunky_bits_tpu.gateway.workers import serve_workers
+
+        await serve_workers(
+            cluster, host=host, port=port, workers=workers,
+            max_put_bytes=max_put_bytes,
+            max_concurrent_puts=max_concurrent_puts,
+            min_put_rate=min_put_rate,
+            max_concurrent_gets=max_concurrent_gets,
+            on_ready=on_ready)
+        return
 
     if sanitize_enabled():
         # opt-in runtime concurrency sanitizer: instrument the serving
@@ -295,11 +704,18 @@ async def serve(cluster: Cluster, host: str = "127.0.0.1",
     runner = web.AppRunner(
         make_app(cluster, max_put_bytes=max_put_bytes,
                  max_concurrent_puts=max_concurrent_puts,
-                 min_put_rate=min_put_rate))
+                 min_put_rate=min_put_rate,
+                 max_concurrent_gets=max_concurrent_gets))
     await runner.setup()
-    site = web.TCPSite(runner, host, port)
+    site = web.TCPSite(runner, host, port, reuse_port=reuse_port)
     await site.start()
-    print(f"listening on http://{host}:{port}")
+    bound_port = port
+    server = getattr(site, "_server", None)
+    if server is not None and server.sockets:
+        bound_port = server.sockets[0].getsockname()[1]
+    print(f"listening on http://{host}:{bound_port}", flush=True)
+    if on_ready is not None:
+        on_ready(bound_port)
     try:
         while True:
             await asyncio.sleep(3600)

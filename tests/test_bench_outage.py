@@ -71,6 +71,45 @@ def test_config8_failure_emits_one_json_line():
     assert "error" in rec
 
 
+def test_config9_smoke_emits_one_json_line():
+    """--config 9 --smoke (gateway scale-out A/B at seconds-scale
+    parameters, one supervisor-run worker) honors the driver contract:
+    exactly one parseable JSON line on stdout with the required keys,
+    exit 0."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "9", "--smoke"],
+        cwd=REPO, env=env, capture_output=True, timeout=300)
+    assert r.returncode == 0, r.stderr.decode()[-800:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "workers",
+                "p50_ms", "p99_ms", "p999_ms", "cond_304_speedup"):
+        assert key in rec
+    assert rec["value"] > 0
+    assert rec["unit"] == "req/s"
+
+
+def test_config9_failure_emits_one_json_line():
+    """ANY --config 9 failure (here: invalid parameters) still
+    produces exactly one parseable JSON line and exit 3 — the same
+    contract as configs 8 and the device runs."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "9",
+         "--clients", "0"],
+        cwd=REPO, env=env, capture_output=True, timeout=120)
+    assert r.returncode == 3, r.stderr.decode()[-500:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec
+    assert rec["value"] == 0.0
+    assert "error" in rec
+
+
 def test_seams_only_shrink_and_tolerate_garbage():
     """Inherited env values must not break the contract: malformed or
     larger-than-default values fall back to the real budget."""
