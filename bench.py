@@ -1655,6 +1655,168 @@ def bench_small_objects(argv=()) -> None:
         }))
 
 
+def bench_slab_store(argv=()) -> None:
+    """BASELINE.md config 10: packed slab store vs file-per-chunk A/B
+    (CPU-only, no device, no watchdog).  Many small objects are written
+    and read back through two otherwise-identical clusters — one with
+    plain path destinations (one chunk file per shard), one with
+    ``slab:`` packed destinations (file/slab.py) — and the GC candidate
+    enumeration is timed for both layouts: the dirent walk + per-file
+    stat that find-unused-hashes pays on path destinations vs the slab
+    index scan.  Byte identity between the legs is asserted in-run.
+
+    Flags: ``--objects N`` (default 150), ``--obj-kib N`` object size
+    (default 16), ``--smoke`` (CI-scale: 30 objects).
+
+    Failure contract (tests/test_bench_outage.py): ANY failure still
+    emits exactly one parseable JSON line and exits 3."""
+    import asyncio
+    import contextlib
+    import os
+    import tempfile
+
+    argv = list(argv)
+
+    def flag(name, default, cast):
+        if name in argv:
+            return cast(argv[argv.index(name) + 1])
+        return default
+
+    metric = "slab_small_object_get_ops_d3p2"
+    try:
+        objects = flag("--objects", 150, int)
+        obj_kib = flag("--obj-kib", 16, int)
+        if "--smoke" in argv:
+            objects = min(objects, 30)
+        if objects <= 0 or obj_kib <= 0:
+            raise ValueError("--objects and --obj-kib must be positive")
+
+        from chunky_bits_tpu.cluster import Cluster
+        from chunky_bits_tpu.file import slab as slab_mod
+        from chunky_bits_tpu.utils import aio
+
+        rng = np.random.default_rng(0)
+        payloads = [rng.integers(0, 256, obj_kib << 10,
+                                 dtype=np.uint8).tobytes()
+                    for _ in range(objects)]
+
+        def make_cluster(root: str, packed: bool) -> Cluster:
+            dirs = []
+            for i in range(5):
+                d = os.path.join(root, f"disk{i}")
+                os.makedirs(d, exist_ok=True)
+                dirs.append(f"slab:{d}" if packed else d)
+            meta = os.path.join(root, "meta")
+            os.makedirs(meta, exist_ok=True)
+            return Cluster.from_obj({
+                "destinations": [{"location": d} for d in dirs],
+                "metadata": {"type": "path", "format": "yaml",
+                             "path": meta},
+                # small-object shape: d=3 p=2, 4 KiB chunks — the
+                # regime where per-chunk open/stat overhead dominates
+                "profiles": {"default": {"data": 3, "parity": 2,
+                                         "chunk_size": 12}},
+            })
+
+        def walk_candidates_dirents(root: str) -> int:
+            """The legacy GC enumeration: every dirent listed, every
+            file stat'ed (the --grace-seconds age check)."""
+            n = 0
+            for dirpath, _dirs, files in os.walk(root):
+                if os.path.basename(dirpath) == "meta":
+                    continue
+                for name in files:
+                    os.stat(os.path.join(dirpath, name))
+                    n += 1
+            return n
+
+        def walk_candidates_index(root: str) -> int:
+            """The packed enumeration: one index scan per store."""
+            n = 0
+            for i in range(5):
+                store = slab_mod.get_store(
+                    os.path.join(root, f"disk{i}"))
+                n += len(store.live_names())
+            return n
+
+        async def run_leg(root: str, packed: bool) -> dict:
+            cluster = make_cluster(root, packed)
+            profile = cluster.get_profile(None)
+            t0 = time.perf_counter()
+            for i, payload in enumerate(payloads):
+                await cluster.write_file(
+                    f"o{i:04d}", aio.BytesReader(payload), profile)
+            put_s = time.perf_counter() - t0
+            bodies = []
+            t0 = time.perf_counter()
+            for i in range(objects):
+                ref = await cluster.get_file_ref(f"o{i:04d}")
+                bodies.append(
+                    await cluster.file_read_builder(ref).read_all())
+            get_s = time.perf_counter() - t0
+            for i, body in enumerate(bodies):
+                assert body == payloads[i], \
+                    f"byte identity failed (packed={packed}, obj {i})"
+            walk = (walk_candidates_index if packed
+                    else walk_candidates_dirents)
+            t0 = time.perf_counter()
+            chunks = walk(root)
+            gc_s = time.perf_counter() - t0
+            await cluster.tunables.location_context().aclose()
+            return {"put_ops": objects / put_s,
+                    "get_ops": objects / get_s,
+                    "gc_walk_ms": gc_s * 1000.0,
+                    "chunks": chunks}
+
+        async def run() -> tuple:
+            with contextlib.ExitStack() as stack:
+                files_root = stack.enter_context(
+                    tempfile.TemporaryDirectory())
+                slab_root = stack.enter_context(
+                    tempfile.TemporaryDirectory())
+                files = await run_leg(files_root, packed=False)
+                packed = await run_leg(slab_root, packed=True)
+            return files, packed
+
+        files, packed = asyncio.run(run())
+        get_ab = (packed["get_ops"] / files["get_ops"]
+                  if files["get_ops"] > 0 else 0.0)
+        gc_ab = (files["gc_walk_ms"] / packed["gc_walk_ms"]
+                 if packed["gc_walk_ms"] > 0 else 0.0)
+        print(f"# config 10: {objects} x {obj_kib} KiB objects d=3 p=2 "
+              f"4 KiB chunks over 5 nodes — files PUT/GET "
+              f"{files['put_ops']:.1f}/{files['get_ops']:.1f} obj/s, "
+              f"slab PUT/GET {packed['put_ops']:.1f}/"
+              f"{packed['get_ops']:.1f} obj/s ({get_ab:.2f}x GET) | "
+              f"GC walk {files['gc_walk_ms']:.1f} ms "
+              f"({files['chunks']} dirents) vs "
+              f"{packed['gc_walk_ms']:.1f} ms index ({gc_ab:.1f}x)",
+              file=sys.stderr)
+        print(json.dumps({
+            "metric": metric,
+            "value": round(packed["get_ops"], 1), "unit": "obj/s",
+            # the A/B verdict: >= 1.0 means the packed layout serves
+            # small-object GETs at least as fast as file-per-chunk
+            "vs_baseline": round(get_ab, 3),
+            "put_files_ops": round(files["put_ops"], 1),
+            "put_slab_ops": round(packed["put_ops"], 1),
+            "get_files_ops": round(files["get_ops"], 1),
+            "gc_walk_files_ms": round(files["gc_walk_ms"], 2),
+            "gc_walk_slab_ms": round(packed["gc_walk_ms"], 2),
+            "gc_walk_speedup": round(gc_ab, 2),
+            "chunks": files["chunks"],
+        }))
+    # lint: broad-except-ok the driver contract (ONE parseable JSON
+    # line, always) outranks the traceback; the error text carries it
+    except Exception as err:
+        print(json.dumps({
+            "metric": metric, "value": 0.0, "unit": "obj/s",
+            "vs_baseline": 0.0,
+            "error": f"{type(err).__name__}: {err}",
+        }))
+        sys.exit(3)
+
+
 if __name__ == "__main__":
     # Bench measures the product defaults: the runtime concurrency
     # sanitizer (analysis/sanitizer.py) must stay OFF here even when an
@@ -1675,16 +1837,18 @@ if __name__ == "__main__":
                    "6": lambda: bench_hot_read(sys.argv),
                    "7": lambda: bench_gateway_put(sys.argv),
                    "8": lambda: bench_hedged_read(sys.argv),
-                   "9": lambda: bench_gateway_scaleout(sys.argv)}
+                   "9": lambda: bench_gateway_scaleout(sys.argv),
+                   "10": lambda: bench_slab_store(sys.argv)}
         idx = sys.argv.index("--config") + 1
         which = sys.argv[idx] if idx < len(sys.argv) else ""
         if which not in configs:
-            print(f"usage: bench.py [--config {{1,2,3,4,6,7,8,9}}] — the "
-                  f"device kernel metric (configs 2+3's compute core) is "
-                  f"the default no-arg run (got {which!r}); 6 is the "
-                  f"hot-read cache A/B, 7 the gateway PUT ingest A/B, "
-                  f"8 the hedged-read tail-latency A/B, 9 the gateway "
-                  f"scale-out multi-worker A/B (all CPU-only)",
+            print(f"usage: bench.py [--config {{1,2,3,4,6,7,8,9,10}}] — "
+                  f"the device kernel metric (configs 2+3's compute "
+                  f"core) is the default no-arg run (got {which!r}); 6 "
+                  f"is the hot-read cache A/B, 7 the gateway PUT ingest "
+                  f"A/B, 8 the hedged-read tail-latency A/B, 9 the "
+                  f"gateway scale-out multi-worker A/B, 10 the packed "
+                  f"slab store vs file-per-chunk A/B (all CPU-only)",
                   file=sys.stderr)
             sys.exit(2)
         configs[which]()

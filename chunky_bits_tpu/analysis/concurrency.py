@@ -46,8 +46,11 @@ from chunky_bits_tpu.analysis.callgraph import (
 from chunky_bits_tpu.analysis.rules import Finding, Rule
 
 #: the serve-path packages whose shared objects are per-event-loop by
-#: convention (cluster.py hands out batchers/caches loop-keyed)
-LOOP_SCOPED_PATHS = ("gateway/", "file/", "parallel/")
+#: convention (cluster.py hands out batchers/caches loop-keyed);
+#: cluster/scrub.py rides along — the scrub daemon's task/counters are
+#: exactly the loop/thread-handoff shape this family polices
+LOOP_SCOPED_PATHS = ("gateway/", "file/", "parallel/",
+                     "cluster/scrub.py")
 
 #: class-body marker the CB204 pass reads: every public method of a
 #: ``LOOP_BOUND = True`` class must only ever run on the owning loop's

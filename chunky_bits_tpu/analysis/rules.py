@@ -16,10 +16,13 @@ from typing import Iterator
 #: unbounded wait or a non-daemon worker can hang a serve or block exit
 #: (file_part.py, destination.py and health.py joined with the hedged
 #: I/O scheduler: every await the read race / write failover adds must
-#: stay reachable through a timeout)
+#: stay reachable through a timeout; slab.py and scrub.py joined with
+#: the packed store + scrub daemon: a long-running background walker
+#: is exactly the shape that hangs a shutdown if any wait is unbounded)
 DEVICE_NET_PATHS = ("ops/", "parallel/", "gateway/", "file/chunk_cache.py",
-                    "file/file_part.py", "cluster/destination.py",
-                    "cluster/health.py")
+                    "file/file_part.py", "file/slab.py",
+                    "cluster/destination.py", "cluster/health.py",
+                    "cluster/scrub.py")
 
 ENV_PREFIX = "CHUNKY_BITS_TPU_"
 

@@ -139,9 +139,26 @@ class MetadataPath:
         text = self.format.to_string(payload)
 
         def _write() -> None:
+            # Atomic publication, like every other local write in this
+            # repo (file/location._publish_atomically): the reference
+            # truncates in place (metadata.rs:120-130), which lets a
+            # concurrent reader observe an empty/torn reference — a
+            # live hazard now that the scrub daemon republishes
+            # metadata while clients read it.
+            from chunky_bits_tpu.file.location import publish_temp_name
+
             os.makedirs(os.path.dirname(target), exist_ok=True)
-            with open(target, "w") as f:
-                f.write(text)
+            tmp = publish_temp_name(target)
+            try:
+                with open(tmp, "w") as f:
+                    f.write(text)
+                os.replace(tmp, target)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
 
         try:
             await asyncio.to_thread(_write)

@@ -1,0 +1,491 @@
+"""Continuous scrub/repair daemon: verify data before a client finds it
+corrupt.
+
+A TPU-repo extension beyond the reference (``Chunky-Bits`` verifies only
+on demand, src/file/file_part.rs:228-251): at scale, latent sector
+errors dominate durability math — a chunk that rotted months ago is only
+discovered when a read needs it, by which point its stripe may have lost
+more than ``p`` chunks.  The scrub daemon walks every file reference in
+the cluster's metadata store on a cycle, re-hashes each chunk replica
+against its golden digest on the shared ``HostPipeline``, feeds
+corruption demerits into the cluster's ``HealthScoreboard``, and
+triggers a bounded resilver of any damaged part.  PAPERS.md's
+"Fast Product-Matrix Regenerating Codes" (1412.3022) frames repair as a
+scheduled, bandwidth-metered background job rather than an on-demand
+full re-read; this module is that scheduler for the verification side
+(the resilver it triggers reuses the existing repair machinery).
+
+**Byte-rate bound.**  Scrub I/O competes with client traffic, so the
+walk is token-bucket bounded: ``tunables.scrub_bytes_per_sec``
+(``$CHUNKY_BITS_TPU_SCRUB_BYTES_PER_SEC``; YAML wins) is the sustained
+budget, with a one-second burst.  0 (the default) means the daemon is
+never constructed — zero overhead when off, per the
+measure-before-defaulting invariant.
+
+**Priority.**  Each pass scans files whose chunks live on *degraded*
+nodes (open/half-open breaker or high error EWMA, per the scoreboard)
+first: data co-resident with a failing disk is the data most likely to
+be the next loss, so it gets verified — and repaired — before the
+healthy tail of the namespace.
+
+**Concurrency shape** (the CB204 audience): the daemon is a plain
+asyncio task on its caller's loop; hashing hops to the host pipeline's
+worker threads and returns through the pipeline's loop-safe bridge; the
+scoreboard is thread-safe by construction.  ``stop()`` cancels and
+AWAITS the task — the daemon can never leak past its owner (pinned
+under ``CHUNKY_BITS_TPU_SANITIZE=1`` in tests/test_scrub.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from chunky_bits_tpu.errors import ChunkyBitsError, LocationError
+
+log = logging.getLogger("chunky_bits_tpu.scrub")
+
+
+def _canonical(obj: object) -> str:
+    """Canonical serialization of a metadata object — the scrub repair
+    fence compares the stored bytes' *meaning*, so a format-level
+    rewrite (key order, yaml vs json) never reads as a client write."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def _ref_from_obj(obj: object):
+    from chunky_bits_tpu.file.file_reference import FileReference
+
+    return FileReference.from_obj(obj)
+
+
+class TokenBucket:
+    """Sustained byte-rate bound with a one-second burst.  ``take(n)``
+    sleeps until ``n`` bytes of budget have accrued; oversized requests
+    (one chunk larger than the burst) drive the balance negative so the
+    *average* still honors the rate.  A rate of 0 disables the bound
+    (take returns immediately) — the daemon itself is not constructed
+    at rate 0, but --once CLI runs may scrub unthrottled."""
+
+    #: bound on a single sleep slice so cancellation (daemon stop)
+    #: is always prompt
+    MAX_SLEEP = 0.5
+
+    def __init__(self, rate: float) -> None:
+        self.rate = max(float(rate), 0.0)
+        self._balance = self.rate  # start with one second of burst
+        self._last = time.monotonic()
+
+    def _accrue(self) -> None:
+        now = time.monotonic()
+        self._balance = min(
+            self._balance + (now - self._last) * self.rate, self.rate)
+        self._last = now
+
+    async def take(self, nbytes: int) -> None:
+        if self.rate <= 0:
+            return
+        self._accrue()
+        self._balance -= nbytes
+        while self._balance < 0:
+            wait = min(-self._balance / self.rate, self.MAX_SLEEP)
+            await asyncio.sleep(wait)
+            self._accrue()
+
+
+@dataclass
+class ScrubStats:
+    """Counter snapshot — the ``Scrub<...>`` profiler stanza and the
+    gateway's ``/scrub/status`` payload."""
+
+    passes: int
+    files_scanned: int
+    chunks_scanned: int
+    bytes_verified: int
+    corrupt: int
+    unavailable: int
+    repaired: int
+    repair_failures: int
+    rate_bytes_per_sec: float
+    running: bool
+    last_pass_seconds: Optional[float]
+
+    def to_obj(self) -> dict:
+        return {
+            "running": self.running,
+            "passes": self.passes,
+            "files_scanned": self.files_scanned,
+            "chunks_scanned": self.chunks_scanned,
+            "bytes_verified": self.bytes_verified,
+            "corrupt": self.corrupt,
+            "unavailable": self.unavailable,
+            "repaired": self.repaired,
+            "repair_failures": self.repair_failures,
+            "rate_bytes_per_sec": self.rate_bytes_per_sec,
+            **({"last_pass_seconds": round(self.last_pass_seconds, 3)}
+               if self.last_pass_seconds is not None else {}),
+        }
+
+    def __str__(self) -> str:
+        rate = (f"{self.rate_bytes_per_sec:.0f}B/s"
+                if self.rate_bytes_per_sec > 0 else "unbounded")
+        return (f"Scrub<scanned={self.files_scanned}f/"
+                f"{self.chunks_scanned}c "
+                f"verified={self.bytes_verified}B "
+                f"corrupt={self.corrupt} repaired={self.repaired} | "
+                f"rate={rate}>")
+
+
+class ScrubDaemon:
+    """One cluster's scrub/repair loop.
+
+    ``run_once`` is a single full pass (the CLI's ``scrub --once``);
+    ``start``/``stop`` run passes continuously with ``interval_seconds``
+    of idle between them (the gateway's long-running mode).  ``repair``
+    False turns detection-only mode on (report + demerit, never write).
+    """
+
+    def __init__(self, cluster, bytes_per_sec: Optional[float] = None,
+                 interval_seconds: float = 60.0, repair: bool = True,
+                 profile_name: Optional[str] = None) -> None:
+        self.cluster = cluster
+        rate = (cluster.tunables.scrub_bytes_per_sec
+                if bytes_per_sec is None else float(bytes_per_sec))
+        self.rate = max(rate, 0.0)
+        self.interval_seconds = max(float(interval_seconds), 0.0)
+        self.repair = repair
+        self.profile_name = profile_name
+        self._bucket = TokenBucket(self.rate)
+        self._task: Optional[asyncio.Task] = None
+        # counters are read by profiler reports and the gateway status
+        # handler (possibly from another thread than the pass loop's)
+        self._lock = threading.Lock()
+        self._passes = 0
+        self._files = 0
+        self._chunks = 0
+        self._bytes = 0
+        self._corrupt = 0
+        self._unavailable = 0
+        self._repaired = 0
+        self._repair_failures = 0
+        self._last_pass_seconds: Optional[float] = None
+
+    # ---- reporting ----
+
+    def _bump(self, **deltas: int) -> None:
+        with self._lock:
+            for key, delta in deltas.items():
+                setattr(self, f"_{key}", getattr(self, f"_{key}") + delta)
+
+    def stats(self) -> ScrubStats:
+        with self._lock:
+            return ScrubStats(
+                passes=self._passes,
+                files_scanned=self._files,
+                chunks_scanned=self._chunks,
+                bytes_verified=self._bytes,
+                corrupt=self._corrupt,
+                unavailable=self._unavailable,
+                repaired=self._repaired,
+                repair_failures=self._repair_failures,
+                rate_bytes_per_sec=self.rate,
+                running=self._task is not None and not self._task.done(),
+                last_pass_seconds=self._last_pass_seconds,
+            )
+
+    # ---- the walk ----
+
+    async def _list_file_paths(self) -> list[str]:
+        """Every file path in the metadata store (depth-first; per-dir
+        failures skip the subtree rather than abort the pass — a scrub
+        must survive a half-broken namespace)."""
+        out: list[str] = []
+        stack = ["."]
+        while stack:
+            path = stack.pop()
+            try:
+                entries = await self.cluster.list_files(path)
+            except ChunkyBitsError:
+                continue
+            for entry in entries:
+                if str(entry.path) in (".", path):
+                    continue  # the listing's own top entry
+                if entry.is_directory():
+                    stack.append(entry.path)
+                elif entry.is_file():
+                    out.append(entry.path)
+        return out
+
+    def _ref_priority(self, ref) -> int:
+        """0 = any chunk replica lives on a degraded node (scan first),
+        1 = all-healthy.  With no health data every ref scores 1 and
+        the pass order is the plain namespace order."""
+        health = self.cluster.health_scoreboard()
+        for part in ref.parts:
+            for chunk in part.data + part.parity:
+                for location in chunk.locations:
+                    if health.degraded(location):
+                        return 0
+        return 1
+
+    async def _verify_chunk(self, chunk, location, cx, pipe
+                            ) -> Optional[bool]:
+        """True = replica matches its golden digest, False = corrupt,
+        None = unreadable.  Fused native hashing where the replica is
+        local/packed (bytes never surface to Python); generic
+        read+verify otherwise.  The byte budget is taken BEFORE the
+        I/O — the bound meters bytes touched, not bytes that happened
+        to verify."""
+        from chunky_bits_tpu.file.file_part import _hash_local_fused
+
+        nbytes = None
+        try:
+            nbytes = await location.file_len(cx)
+        except LocationError:
+            return None
+        await self._bucket.take(nbytes)
+        digest = await _hash_local_fused(chunk, location, cx, pipe)
+        if digest is not None:
+            self._bump(bytes=nbytes)
+            return digest == chunk.hash.value.digest
+        try:
+            data = await location.read(cx)
+        except LocationError:
+            return None
+        self._bump(bytes=len(data))
+        ok = await pipe.run(
+            "verify", lambda: chunk.hash.verify(data),
+            nbytes=len(data))
+        return bool(ok)
+
+    async def _rewrite_replicas(self, chunk, source, victims, cx,
+                                pipe) -> None:
+        """Overwrite corrupt/missing replicas of ``chunk`` in place
+        with the verified bytes from ``source`` (content-addressed, so
+        an overwrite matching the hash is always safe — the same
+        rationale as resilver's overwrite deviation).  Reads and
+        writes are metered through the byte budget like verification
+        is."""
+        from chunky_bits_tpu.file.location import OVERWRITE
+
+        try:
+            nbytes = await source.file_len(cx)
+            await self._bucket.take(nbytes)
+            data = await source.read(cx)
+        except LocationError:
+            return  # the healthy replica vanished: next pass decides
+        ok = await pipe.run(
+            "verify", lambda: chunk.hash.verify(data),
+            nbytes=len(data))
+        if not ok:
+            return  # raced a writer; don't spread unverified bytes
+        overwrite_cx = cx.but_with(on_conflict=OVERWRITE)
+        for victim in victims:
+            await self._bucket.take(len(data))
+            try:
+                await victim.write(data, overwrite_cx)
+            except LocationError:
+                # node still down/full: counted, retried next pass
+                self._bump(repair_failures=1)
+                continue
+            self._bump(repaired=1)
+
+    async def _scrub_ref(self, path: str, ref, cx, pipe,
+                         snapshot: str) -> None:
+        """Verify every replica of every chunk of one file; resilver
+        damaged parts (missing or corrupt replicas) in place and
+        republish the metadata, the same sequence as the CLI's
+        ``resilver`` command.  ``snapshot`` is the canonical serialized
+        form of ``ref`` as fetched — the republish is fenced on the
+        stored metadata still matching it, so a client overwrite that
+        landed while this (rate-bounded, possibly long) scrub was
+        running is never clobbered with a stale repaired ref."""
+        health = self.cluster.health_scoreboard()
+        damaged_parts = []
+        for part in ref.parts:
+            part_damaged = False
+            for chunk in part.data + part.parity:
+                self._bump(chunks=1)
+                good = None
+                victims = []  # corrupt/missing replicas to rewrite
+                for location in chunk.locations:
+                    verdict = await self._verify_chunk(
+                        chunk, location, cx, pipe)
+                    if verdict is True:
+                        if good is None:
+                            good = location
+                    elif verdict is False:
+                        # corrupt content on a successful transfer is
+                        # still a demerit for the node serving it —
+                        # the same rule as the read path's _corrupt
+                        self._bump(corrupt=1)
+                        health.record(location, False)
+                        victims.append(location)
+                    else:
+                        self._bump(unavailable=1)
+                        victims.append(location)
+                if good is None:
+                    # no valid replica anywhere: this is resilver's
+                    # job (rebuild from the part's other chunks)
+                    part_damaged = True
+                elif victims and self.repair:
+                    # a corrupt/missing replica BESIDE a healthy one is
+                    # rewritten in place with the verified bytes —
+                    # resilver only rebuilds chunks with NO valid
+                    # replica (chunk_status short-circuit), so without
+                    # this the same rotten extent would be re-detected
+                    # (and the node re-demerited) every pass forever
+                    await self._rewrite_replicas(chunk, good, victims,
+                                                 cx, pipe)
+            if part_damaged:
+                damaged_parts.append(part)
+        self._bump(files=1)
+        if not damaged_parts or not self.repair:
+            return
+        profile = self.cluster.get_profile(self.profile_name)
+        if profile is None:
+            self._bump(repair_failures=len(damaged_parts))
+            return
+        destination = self.cluster.get_destination(profile)
+        for part in damaged_parts:
+            # repair I/O is charged to the same byte budget as
+            # verification, at part granularity: resilver re-reads
+            # every replica and writes the rebuilt shards, so a
+            # mass-repair pass after a node loss must throttle like
+            # the scan does instead of saturating disks at full speed
+            replicas = sum(len(c.locations)
+                           for c in part.data + part.parity)
+            await self._bucket.take(part.chunksize * (replicas + 1))
+            try:
+                report = await part.resilver(
+                    destination, cx,
+                    backend=self.cluster.tunables.backend,
+                    pipeline=pipe)
+            # lint: broad-except-ok a failed repair is a counter and a
+            # retry next pass, never a dead daemon mid-namespace
+            except Exception:
+                self._bump(repair_failures=1)
+                continue
+            if report.successful_writes() and not report.failed_writes():
+                self._bump(repaired=1)
+            elif report.failed_writes():
+                self._bump(repair_failures=1)
+        try:
+            # republish fence: only write back if the stored metadata
+            # still matches what this scrub read — an overwrite that
+            # raced the pass wins, and its chunks get scrubbed next
+            # pass instead of being reverted to a stale ref.  (The
+            # remaining window between this read and the write is one
+            # metadata round-trip, not a whole rate-bounded pass.)
+            current = _canonical(await self.cluster.metadata.read(path))
+            if current != snapshot:
+                return
+            await self.cluster.write_file_ref(path, ref)
+        except ChunkyBitsError:
+            self._bump(repair_failures=1)
+
+    async def run_once(self) -> ScrubStats:
+        """One full pass over the namespace, degraded-resident files
+        first.  Returns the cumulative stats snapshot.
+
+        Only the path list (plus an int priority each) is held across
+        the pass — refs are fetched per file, right before their scrub,
+        never retained: a rate-bounded pass can run for hours, and at
+        namespace scale holding every parsed FileReference would be
+        unbounded memory AND guarantee every repair republishes
+        hours-stale metadata."""
+        started = time.monotonic()
+        cx = self.cluster.tunables.location_context()
+        pipe = self.cluster.host_pipeline()
+        paths = await self._list_file_paths()
+        scored: list[tuple[int, str]] = []
+        for path in paths:
+            try:
+                # metadata.read, NOT get_file_ref: the priority
+                # pre-scan sweeps the whole namespace and must not
+                # churn the serving path's file-ref LRU (a pass would
+                # evict every hot ref the gateway is using)
+                ref = _ref_from_obj(
+                    await self.cluster.metadata.read(path))
+            except ChunkyBitsError:
+                continue  # unparseable/foreign metadata: skip
+            scored.append((self._ref_priority(ref), path))
+        scored.sort(key=lambda t: t[0])
+        for _prio, path in scored:
+            try:
+                obj = await self.cluster.metadata.read(path)
+                snapshot = _canonical(obj)
+                ref = _ref_from_obj(obj)
+            except ChunkyBitsError:
+                continue  # deleted/rewritten mid-pass: next pass's job
+            await self._scrub_ref(path, ref, cx, pipe, snapshot)
+        with self._lock:
+            self._passes += 1
+            self._last_pass_seconds = time.monotonic() - started
+        return self.stats()
+
+    # ---- daemon lifetime ----
+
+    async def _run_forever(self) -> None:
+        while True:
+            try:
+                await self.run_once()
+            except asyncio.CancelledError:
+                raise
+            # lint: broad-except-ok a failed pass must never silently
+            # end continuous scrubbing for the process's remaining
+            # lifetime; logged, and the next interval retries
+            except Exception:
+                log.exception("scrub pass failed; retrying after "
+                              "interval")
+            if self.interval_seconds <= 0:
+                # rate-bounded back-to-back passes still yield between
+                # chunks via the bucket; give the loop one tick anyway
+                await asyncio.sleep(0)
+                continue
+            await asyncio.sleep(self.interval_seconds)
+
+    def start(self) -> None:
+        """Start the continuous loop on the running event loop.
+        Idempotent while running; a finished/crashed task restarts
+        (the rolling-restart shape tests/test_chaos.py drives)."""
+        if self._task is not None and not self._task.done():
+            return
+        self._task = asyncio.ensure_future(self._run_forever())
+
+    async def stop(self) -> None:
+        """Cancel AND await the pass loop — stop() returning means no
+        scrub task survives (the no-leaked-tasks contract)."""
+        task, self._task = self._task, None
+        if task is None:
+            return
+        task.cancel()
+        try:
+            # lint: unbounded-await-ok the task was cancelled on the
+            # line above and every wait inside the pass loop is a
+            # bounded sleep slice (TokenBucket.MAX_SLEEP) or bounded
+            # I/O, so cancellation delivery is prompt by construction
+            await task
+        except asyncio.CancelledError:
+            pass
+        # lint: broad-except-ok stop() returning means the task is
+        # gone — a pass that died with a stray exception must not
+        # re-raise here and abort the caller's shutdown sequence
+        # (gateway serve's finally runs runner.cleanup after this)
+        except Exception:
+            log.exception("scrub task ended with an error")
+
+
+def maybe_build(cluster, **kwargs) -> Optional[ScrubDaemon]:
+    """A daemon for ``cluster`` when its ``scrub_bytes_per_sec`` tunable
+    asks for one, else None — THE off-by-default gate: at rate 0 no
+    daemon object exists, no task runs, nothing is imported at serve
+    time beyond this check."""
+    if cluster.tunables.scrub_bytes_per_sec <= 0:
+        return None
+    return ScrubDaemon(cluster, **kwargs)

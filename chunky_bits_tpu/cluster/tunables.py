@@ -88,6 +88,16 @@ GATEWAY_WORKERS_ENV = "CHUNKY_BITS_TPU_GATEWAY_WORKERS"
 #: path.  Read at app build.
 GATEWAY_SENDFILE_ENV = "CHUNKY_BITS_TPU_GATEWAY_SENDFILE"
 
+#: continuous scrub/repair byte-rate bound (cluster/scrub.py): the
+#: scrub daemon verifies chunks against their golden digests at most
+#: this many bytes per second (token bucket, 1 s burst).  0/unset =
+#: scrub off — the daemon is never constructed, zero overhead (the
+#: measure-before-defaulting invariant: background repair traffic is
+#: load, so it is opt-in).  YAML ``scrub_bytes_per_sec`` wins; the env
+#: var supplies the default.  Read when the daemon starts (gateway
+#: serve / `chunky-bits scrub`).
+SCRUB_BYTES_PER_SEC_ENV = "CHUNKY_BITS_TPU_SCRUB_BYTES_PER_SEC"
+
 #: opt-in runtime concurrency sanitizer (analysis/sanitizer.py):
 #: event-loop stall watchdog, task-leak registry, host-pipeline handoff
 #: checks.  Off by default (and force-disabled by bench.py — the
@@ -226,6 +236,18 @@ def hedge_ms(*, default: float = 0.0) -> float:
     return v if v > 0 else default
 
 
+def scrub_bytes_per_sec(*, default: float = 0.0) -> float:
+    """Env-supplied default for the ``scrub_bytes_per_sec`` tunable
+    (YAML wins; 0 = the scrub daemon stays off).  Lenient like
+    ``hedge_ms`` — malformed or negative values read as off."""
+    raw = os.environ.get(SCRUB_BYTES_PER_SEC_ENV, "")
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
 def read_retries(*, default: int = 1) -> int:
     """Env-supplied default for the ``read_retries`` tunable (YAML
     wins): per-location transient-HTTP retry count on the read
@@ -247,6 +269,12 @@ def _default_hedge_ms() -> float:
 def _default_read_retries() -> int:
     """Env-supplied default for the ``read_retries`` tunable."""
     return read_retries(default=1)
+
+
+def _default_scrub_bytes_per_sec() -> float:
+    """Env-supplied default for the ``scrub_bytes_per_sec`` tunable
+    (YAML wins; 0 = scrub daemon off)."""
+    return scrub_bytes_per_sec(default=0.0)
 
 
 def _default_host_threads() -> int:
@@ -288,6 +316,11 @@ class Tunables:
     #: shard-write failover); YAML wins over
     #: ``CHUNKY_BITS_TPU_READ_RETRIES``.
     read_retries: int = field(default_factory=_default_read_retries)
+    #: continuous-scrub byte-rate bound (cluster/scrub.py); 0 keeps the
+    #: daemon off (the default — zero overhead when off).  YAML wins;
+    #: ``CHUNKY_BITS_TPU_SCRUB_BYTES_PER_SEC`` supplies the default.
+    scrub_bytes_per_sec: float = field(
+        default_factory=_default_scrub_bytes_per_sec)
 
     def is_device_backend(self) -> bool:
         """True when the erasure plane runs on an accelerator ("jax" or a
@@ -352,6 +385,16 @@ class Tunables:
             if read_retries_v < 0:
                 raise SerdeError(
                     f"read_retries must be >= 0, got {read_retries_v}")
+        scrub_v = obj.get("scrub_bytes_per_sec", None)
+        if scrub_v is not None:
+            try:
+                scrub_v = float(scrub_v)
+            except (TypeError, ValueError) as err:
+                raise SerdeError(
+                    f"invalid scrub_bytes_per_sec {scrub_v!r}") from err
+            if scrub_v < 0:
+                raise SerdeError(
+                    f"scrub_bytes_per_sec must be >= 0, got {scrub_v}")
         return cls(
             https_only=bool(obj.get("https_only", False)),
             on_conflict=on_conflict,
@@ -365,6 +408,8 @@ class Tunables:
                if hedge_ms_v is not None else {}),
             **({"read_retries": read_retries_v}
                if read_retries_v is not None else {}),
+            **({"scrub_bytes_per_sec": scrub_v}
+               if scrub_v is not None else {}),
         )
 
     def to_obj(self) -> dict:
@@ -383,6 +428,8 @@ class Tunables:
             obj["hedge_ms"] = self.hedge_ms
         if self.read_retries != 1:
             obj["read_retries"] = self.read_retries
+        if self.scrub_bytes_per_sec > 0:
+            obj["scrub_bytes_per_sec"] = self.scrub_bytes_per_sec
         return obj
 
     def location_context(self) -> LocationContext:

@@ -112,15 +112,18 @@ async def _hash_local_fused(chunk: Chunk, location: Location,
                             cx: LocationContext,
                             pipeline: Optional["HostPipeline"] = None
                             ) -> Optional[bytes]:
-    """Digest of a local chunk file via the native streaming read+hash
-    pass (C++ SHA-NI; ops/cpu_backend.sha256_file), which never surfaces
-    the bytes to Python.  Returns None when the fast path doesn't apply —
-    non-local / extend-zeros-range locations, non-sha256 hashes, an
-    active profiler (which must see the generic read), a missing native
-    build, or any I/O failure (the generic path re-reads and reports the
-    error in its own words)."""
+    """Digest of a local or slab-packed chunk via the native streaming
+    read+hash pass (C++ SHA-NI; ops/cpu_backend.sha256_file), which
+    never surfaces the bytes to Python — slab extents hash in place
+    as ``sha256_file(slab_path, extent_offset + start, length)``.
+    Returns None when the fast path doesn't apply — http /
+    extend-zeros-range locations, non-sha256 hashes, an active profiler
+    (which must see the generic read), a missing native build, or any
+    I/O failure (the generic path re-reads and reports the error in
+    its own words)."""
     global _FUSED_HASHER
-    if (cx.profiler is not None or not location.is_local()
+    if (cx.profiler is not None
+            or not (location.is_local() or location.is_slab())
             or location.range.extend_zeros
             or chunk.hash.algorithm != "sha256"):
         return None
@@ -138,12 +141,27 @@ async def _hash_local_fused(chunk: Chunk, location: Location,
     if _FUSED_HASHER is False:
         return None
     hasher = _FUSED_HASHER
+    path = location.target
+    start = location.range.start or 0
+    length = location.range.length
+    if location.is_slab():
+        ext = await asyncio.to_thread(location.slab_extent)
+        if ext is None:
+            return None  # generic path reports the miss in its words
+        path, base, ext_len = ext
+        avail = max(ext_len - start, 0)
+        if length is None:
+            length = avail
+        elif length > avail:
+            # a short range reads short on the generic path; the fused
+            # pass must not hash past the extent into a neighbor chunk
+            return None
+        start += base
     try:
         return await _pipe(pipeline).run(
             "verify",
-            lambda: hasher(location.target, location.range.start or 0,
-                           location.range.length),
-            nbytes=location.range.length or 0)
+            lambda: hasher(path, start, length),
+            nbytes=length or 0)
     except OSError:
         return None
 

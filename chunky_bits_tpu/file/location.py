@@ -393,8 +393,8 @@ class _ProfiledReader:
 class Location:
     """A storage address; value semantics, string serde."""
 
-    kind: str  # "local" | "http"
-    target: str  # filesystem path, or full URL
+    kind: str  # "local" | "http" | "slab"
+    target: str  # filesystem path, full URL, or slab <root>/<name> path
     range: Range = field(default_factory=Range)
 
     # ---- construction / parsing ----
@@ -413,6 +413,17 @@ class Location:
             if not path.startswith("/"):
                 raise LocationParseError("file:// path must be absolute")
             return Location("local", path, rng)
+        if rest.startswith("slab:"):
+            # packed slab store address (file/slab.py): the path names
+            # <store root>/<chunk name> — chunk bytes live inside the
+            # root's slab files, addressed through its index
+            path = rest[len("slab:"):]
+            if not path:
+                raise LocationParseError("empty slab location")
+            if "://" in path.split("/")[0]:
+                raise LocationParseError(
+                    f"invalid slab location: {rest!r}")
+            return Location("slab", path, rng)
         if "://" in rest.split("/")[0]:
             raise LocationParseError(f"invalid location scheme: {rest!r}")
         if not rest:
@@ -424,15 +435,20 @@ class Location:
         return Location("local", str(path), rng or Range())
 
     @staticmethod
+    def slab(path: str, rng: Optional[Range] = None) -> "Location":
+        return Location("slab", str(path), rng or Range())
+
+    @staticmethod
     def http(url: str, rng: Optional[Range] = None) -> "Location":
         if not (url.startswith("http://") or url.startswith("https://")):
             raise LocationParseError(f"not an http url: {url!r}")
         return Location("http", url, rng or Range())
 
     def __str__(self) -> str:
+        prefix = "slab:" if self.is_slab() else ""
         if self.range.is_specified():
-            return f"{self.range}{self.target}"
-        return self.target
+            return f"{self.range}{prefix}{self.target}"
+        return f"{prefix}{self.target}"
 
     def is_http(self) -> bool:
         return self.kind == "http"
@@ -440,14 +456,44 @@ class Location:
     def is_local(self) -> bool:
         return self.kind == "local"
 
+    def is_slab(self) -> bool:
+        return self.kind == "slab"
+
     def with_range(self, rng: Range) -> "Location":
         return replace(self, range=rng)
+
+    # ---- slab addressing (file/slab.py) ----
+
+    def _slab_parts(self) -> tuple[str, str]:
+        """(store root, chunk name) for a slab chunk address."""
+        root, name = os.path.split(self.target.rstrip("/"))
+        if not root or not name:
+            raise LocationError(
+                f"slab location {self.target!r} names a store root, "
+                "not a chunk")
+        return root, name
+
+    def _slab_store(self):
+        from chunky_bits_tpu.file import slab
+
+        return slab.get_store(self._slab_parts()[0])
+
+    def slab_extent(self) -> Optional[tuple[str, int, int]]:
+        """(slab file path, offset, length) of a live packed chunk, or
+        None (not a slab location / no such chunk).  Sync — may read
+        the store's index journal; off-loop callers only."""
+        if not self.is_slab():
+            return None
+        try:
+            return self._slab_store().extent_path(self._slab_parts()[1])
+        except (OSError, LocationError):
+            return None
 
     # ---- hierarchy (src/file/location.rs:407-436) ----
 
     def child(self, name: str) -> "Location":
-        if self.is_local():
-            return Location("local", os.path.join(self.target, name))
+        if not self.is_http():
+            return Location(self.kind, os.path.join(self.target, name))
         parts = urlsplit(self.target)
         path = parts.path.rstrip("/") + "/" + quote(name, safe="")
         return Location(
@@ -458,7 +504,7 @@ class Location:
             return False
         if self.kind != other.kind:
             return False
-        if self.is_local():
+        if not self.is_http():
             return os.path.dirname(self.target) == other.target.rstrip("/") \
                 or os.path.dirname(self.target) == other.target
         left = urlsplit(self.target)
@@ -543,6 +589,40 @@ class Location:
     async def _open_reader(self, cx: LocationContext
                            ) -> aio.AsyncByteReader:
         rng = self.range
+        if self.is_slab():
+            # packed chunk: one indexed open+seek into the slab file,
+            # bounded by the extent (the slab-plane analogue of the
+            # one-file open below; short ranges read short, exactly
+            # like a local file that ends early)
+            if rng.start < 0 or (rng.length is not None
+                                 and rng.length < 0):
+                raise LocationError(
+                    f"negative range {rng} on slab location")
+            root, name = self._slab_parts()
+            store = self._slab_store()
+
+            def _open():
+                ext = store.lookup(name)
+                if ext is None:
+                    raise FileNotFoundError(
+                        f"no live chunk {name!r} in slab store {root}")
+                f = open(store.slab_path(ext.slab), "rb")
+                f.seek(ext.offset + rng.start)
+                return f, ext
+
+            try:
+                f, ext = await asyncio.to_thread(_open)
+            except OSError as err:
+                raise LocationError(str(err)) from err
+            base = aio.FileReader(store.slab_path(ext.slab), fileobj=f)
+            avail = max(ext.length - rng.start, 0)
+            if rng.length is None:
+                return aio.TakeReader(base, avail)
+            if rng.extend_zeros:
+                return aio.ZeroExtendReader(
+                    aio.TakeReader(base, min(avail, rng.length)),
+                    rng.length)
+            return aio.TakeReader(base, min(rng.length, avail))
         if self.is_local():
             try:
                 f = await asyncio.to_thread(open, self.target, "rb")
@@ -665,11 +745,30 @@ class Location:
         hop latency, not bytes, dominates warm local reads on small
         hosts."""
         cx = cx or default_context()
-        if (not self.is_local() or cx.profiler is not None
+        if (not (self.is_local() or self.is_slab())
+                or cx.profiler is not None
                 or aio.mmap_opted_out()):
             return None
         rng = self.range
         health = cx.health  # thread-safe scoreboard; _map runs off-loop
+        if self.is_slab():
+            try:
+                root_name = self._slab_parts()
+            except LocationError:
+                return None
+            store = self._slab_store()
+            location = self
+
+            def _map_slab() -> Optional[memoryview]:
+                t0 = time.monotonic()
+                view = store.map_view(root_name[1], rng.start or 0,
+                                      rng.length)
+                if view is not None and health is not None:
+                    health.record(location, True,
+                                  time.monotonic() - t0)
+                return view
+
+            return _map_slab
 
         def _map() -> Optional[memoryview]:
             import mmap
@@ -719,7 +818,17 @@ class Location:
                 if cx.profiler is not None:
                     cx.profiler.log_write(True, None, self, len(data), start)
                 return
-            if self.is_local():
+            if self.is_slab():
+                # packed publication: slab append + journal commit
+                # (file/slab.py's atomic-index protocol) — the slab
+                # plane's equivalent of the rename publication below
+                root, name = self._slab_parts()
+                store = self._slab_store()
+                try:
+                    await asyncio.to_thread(store.append, name, data)
+                except OSError as err:
+                    raise LocationError(str(err)) from err
+            elif self.is_local():
                 try:
                     await _atomic_publish(self.target, data)
                 except OSError as err:
@@ -790,6 +899,25 @@ class Location:
             raise WriteToRangeError()
         if cx.on_conflict == IGNORE and await self.file_exists(cx):
             return 0
+        if self.is_slab():
+            # the slab journal commits (name -> extent) in one record,
+            # so the whole body must be known before publication:
+            # buffer the stream (chunk payloads are bounded by the
+            # profile's chunksize) and append once
+            chunks: list[bytes] = []
+            while True:
+                data = await reader.read(1 << 20)
+                if not data:
+                    break
+                chunks.append(data)
+            payload = b"".join(chunks)
+            root, name = self._slab_parts()
+            store = self._slab_store()
+            try:
+                await asyncio.to_thread(store.append, name, payload)
+            except OSError as err:
+                raise LocationError(str(err)) from err
+            return len(payload)
         if self.is_local():
             try:
                 return await _atomic_publish_stream(reader, self.target)
@@ -835,7 +963,16 @@ class Location:
 
     async def delete(self, cx: Optional[LocationContext] = None) -> None:
         cx = cx or default_context()
-        if self.is_local():
+        if self.is_slab():
+            # GC of a packed chunk marks the extent dead in the index
+            # (reclaimed by SlabStore.compact), never punches the slab
+            root, name = self._slab_parts()
+            store = self._slab_store()
+            try:
+                await asyncio.to_thread(store.mark_dead, name)
+            except OSError as err:
+                raise LocationError(str(err)) from err
+        elif self.is_local():
             try:
                 await asyncio.to_thread(os.remove, self.target)
             except OSError as err:
@@ -855,6 +992,10 @@ class Location:
 
     async def file_exists(self, cx: Optional[LocationContext] = None) -> bool:
         cx = cx or default_context()
+        if self.is_slab():
+            store = self._slab_store()
+            name = self._slab_parts()[1]
+            return await asyncio.to_thread(store.lookup, name) is not None
         if self.is_local():
             return await asyncio.to_thread(os.path.exists, self.target)
         self._check_scheme(cx)
@@ -870,6 +1011,14 @@ class Location:
 
     async def file_len(self, cx: Optional[LocationContext] = None) -> int:
         cx = cx or default_context()
+        if self.is_slab():
+            store = self._slab_store()
+            name = self._slab_parts()[1]
+            ext = await asyncio.to_thread(store.lookup, name)
+            if ext is None:
+                raise LocationError(
+                    f"no live chunk {name!r} in slab store")
+            return ext.length
         if self.is_local():
             try:
                 st = await asyncio.to_thread(os.stat, self.target)

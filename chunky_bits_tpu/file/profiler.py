@@ -108,6 +108,7 @@ class Profiler:
         self._caches: list = []  # read caches whose counters we surface
         self._pipelines: list = []  # host pipelines ditto
         self._healths: list = []  # location-health scoreboards ditto
+        self._scrubs: list = []  # scrub daemons ditto
         # per-location failure notes from the read fall-through
         # (fetch_chunk): which location failed / was corrupt and why —
         # the diagnosable trail the anonymous `except LocationError:
@@ -159,6 +160,21 @@ class Profiler:
         """Snapshot of each attached scoreboard (HealthStats)."""
         with self._lock:
             return [h.stats() for h in self._healths]
+
+    def attach_scrub(self, scrub) -> None:
+        """Register a scrub daemon (cluster/scrub.py) so its
+        scanned/verified/corrupt/repaired counters and byte-rate ride
+        along in the report — scrub I/O happens outside any one
+        operation's hooks, so without this a scrubbed cluster's reports
+        would not show the background verification at all."""
+        with self._lock:
+            if all(s is not scrub for s in self._scrubs):
+                self._scrubs.append(scrub)
+
+    def scrub_stats(self) -> list:
+        """Snapshot of each attached scrub daemon (ScrubStats)."""
+        with self._lock:
+            return [s.stats() for s in self._scrubs]
 
     def log_location_failure(self, location, error: str) -> None:
         """A per-location read failure (unreadable or hash-mismatched)
@@ -213,13 +229,15 @@ class Profiler:
 class ProfileReport:
     def __init__(self, entries: list[ResultLog], cache_stats: list = (),
                  pipeline_stats: list = (), health_stats: list = (),
-                 location_failures: list = (), requests: list = ()):
+                 location_failures: list = (), requests: list = (),
+                 scrub_stats: list = ()):
         self.entries = entries
         self.cache_stats = list(cache_stats)
         self.pipeline_stats = list(pipeline_stats)
         self.health_stats = list(health_stats)
         self.location_failures = list(location_failures)
         self.requests = list(requests)
+        self.scrub_stats = list(scrub_stats)
 
     def _avg(self, kind: str) -> Optional[float]:
         durations = [e.duration for e in self.entries if e.kind == kind]
@@ -256,6 +274,8 @@ class ProfileReport:
             base += f" {stats}"
         for stats in self.health_stats:
             base += f" {stats}"
+        for stats in self.scrub_stats:
+            base += f" {stats}"
         if self.requests:
             base += f" {request_stats(self.requests)}"
         if self.location_failures:
@@ -280,7 +300,8 @@ class ProfileReporter:
                              self._profiler.pipeline_stats(),
                              self._profiler.health_stats(),
                              self._profiler.drain_location_failures(),
-                             self._profiler.drain_requests())
+                             self._profiler.drain_requests(),
+                             self._profiler.scrub_stats())
 
 
 def new_profiler() -> tuple[Profiler, ProfileReporter]:

@@ -20,14 +20,17 @@ src/http.rs has none of these):
   answer 304 with zero body bytes, so repeat readers of unchanged
   objects cost one metadata read.
 - **Zero-copy local-chunk streaming.**  A requested range covered by ONE
-  data chunk with a verified local replica streams via ``loop.sendfile``
-  (page cache -> socket, no userspace copy), bypassing the whole
-  fetch/verify/reassemble pipeline; verification digests are memoized
-  per (path, size, mtime_ns) — chunk files are content-addressed and
-  replaced only by atomic rename, so a stale memo entry is impossible
-  without an mtime change.  ``tunables.gateway_sendfile`` /
-  ``$CHUNKY_BITS_TPU_GATEWAY_SENDFILE`` disables it (bench --config 9 is
-  the A/B).
+  data chunk with a verified local replica — a whole chunk file OR a
+  live extent inside a packed slab (file/slab.py) — streams via
+  ``loop.sendfile`` (page cache -> socket, no userspace copy),
+  bypassing the whole fetch/verify/reassemble pipeline; verification
+  digests are memoized per (path, offset, length) extent: whole chunk
+  files validate by (size, mtime_ns) token (content-addressed, replaced
+  only by atomic rename — a stale entry is impossible without an mtime
+  change), slab extents by the journaled extent itself (write-once
+  bytes; compaction republishes under a new slab path).
+  ``tunables.gateway_sendfile`` / ``$CHUNKY_BITS_TPU_GATEWAY_SENDFILE``
+  disables it (bench --config 9 is the A/B).
 - **Admission control.**  In-flight GET bodies are bounded
   (``max_concurrent_gets``); excess requests get an immediate
   503 + ``Retry-After`` instead of queueing into memory — the read-side
@@ -251,14 +254,26 @@ def _covering_chunk(file_ref: FileReference, seek: int, length: int):
     return None
 
 
-def _sha256_path(path: str) -> bytes:
-    """Streaming sha256 of a file (the sendfile verify fallback when
-    the native fused hasher is unavailable); runs on the host
-    pipeline's workers, never the loop."""
-    from chunky_bits_tpu.file.hashing import Sha256Hash
+def _sha256_extent(path: str, offset: int,
+                   length: Optional[int]) -> bytes:
+    """Streaming sha256 of a file extent (the sendfile verify fallback
+    when the native fused hasher is unavailable); ``length`` None hashes
+    to EOF.  Runs on the host pipeline's workers, never the loop."""
+    import hashlib
 
+    h = hashlib.sha256()
     with open(path, "rb") as f:
-        return Sha256Hash.from_reader(f).digest
+        f.seek(offset)
+        remaining = length
+        while remaining is None or remaining > 0:
+            n = 1 << 20 if remaining is None else min(1 << 20, remaining)
+            data = f.read(n)
+            if not data:
+                break
+            if remaining is not None:
+                remaining -= len(data)
+            h.update(data)
+    return h.digest()
 
 
 def make_app(cluster: Cluster,
@@ -267,8 +282,8 @@ def make_app(cluster: Cluster,
              min_put_rate: int = DEFAULT_MIN_PUT_RATE,
              max_concurrent_gets: int = DEFAULT_MAX_CONCURRENT_GETS,
              sendfile: Optional[bool] = None,
-             profiler: Optional[Profiler] = None
-             ) -> web.Application:
+             profiler: Optional[Profiler] = None,
+             scrub=None) -> web.Application:
     # <=0 means unbounded, like the reference's ingest (and matching
     # min_put_rate's "0 disables" convention)
     put_sem = (asyncio.Semaphore(max_concurrent_puts)
@@ -287,6 +302,8 @@ def make_app(cluster: Cluster,
     if profiler is None:
         profiler = Profiler()
     profiler.attach_health(cluster.health_scoreboard())
+    if scrub is not None:
+        profiler.attach_scrub(scrub)
 
     # PUT ingest compute (per-shard SHA-256 + per-stripe GF encode) runs
     # on the cluster's host pipeline workers, so the event loop's socket
@@ -310,48 +327,77 @@ def make_app(cluster: Cluster,
     # bookkeeping happens on the app's loop
     gets_in_flight = {"now": 0}
 
-    # (path) -> (size, mtime_ns) of chunk files whose digest verified,
-    # LRU-bounded; keyed state is per-app (= per worker process), like
-    # the chunk cache — see gateway/workers.py on why serving state is
-    # partitioned, not shared, across workers
-    verified_memo: dict[str, tuple[int, int]] = {}
+    # extent key -> validity token of chunk extents whose digest
+    # verified, FIFO-bounded; keyed state is per-app (= per worker
+    # process), like the chunk cache — see gateway/workers.py on why
+    # serving state is partitioned, not shared, across workers.
+    # Whole-file local chunks key (path, 0, size) with token
+    # (size, mtime_ns): atomic-rename publication means same path +
+    # same mtime_ns + same size is the same inode content (the path
+    # itself is the content address).  Packed slab extents additionally
+    # bind the CHUNK DIGEST into the key — slab bytes are write-once
+    # (appends never rewrite a published extent) but slab *names* can
+    # recur (a compact of an emptied store restarts the numbering), so
+    # (path, offset, length) alone could alias a different chunk later;
+    # with the digest in the key a recycled extent address simply
+    # misses and re-verifies.  A file-level mtime token would churn on
+    # every unrelated append to the same slab, hence "extent".
+    verified_memo: dict[tuple, object] = {}
+
+    def _memo_insert(key: tuple, token: object) -> None:
+        verified_memo[key] = token
+        while len(verified_memo) > _VERIFIED_MEMO_ENTRIES:
+            verified_memo.pop(next(iter(verified_memo)))
 
     async def _verify_local_chunk(chunk, location, chunksize: int
-                                  ) -> bool:
-        """True when the local chunk file at ``location`` currently
-        holds exactly the content-addressed bytes ``chunk`` names.
-        Full-file digest on first sight; (size, mtime_ns) memo
-        afterwards — atomic-rename publication means same path + same
-        mtime_ns + same size is the same inode content."""
+                                  ) -> Optional[tuple[str, int]]:
+        """(file path, byte offset) to stream ``chunk``'s verified
+        bytes from — a whole local chunk file, or a live extent inside
+        a packed slab — or None when this replica can't serve the
+        zero-copy path (wrong size, corrupt, missing, non-local).
+        Full digest on first sight; extent-keyed memo afterwards."""
         from chunky_bits_tpu.file.file_part import _hash_local_fused
 
-        path = location.target
-        try:
-            st = await asyncio.to_thread(os.stat, path)
-        except OSError:
-            return False
-        if st.st_size != chunksize:
-            return False
-        if verified_memo.get(path) == (st.st_size, st.st_mtime_ns):
-            return True
+        if location.is_slab():
+            ext = await asyncio.to_thread(location.slab_extent)
+            if ext is None:
+                return None
+            path, base, ext_len = ext
+            if ext_len != chunksize:
+                return None
+            key = (path, base, ext_len, chunk.hash.value.digest)
+            if verified_memo.get(key) == "extent":
+                return (path, base)
+            token: object = "extent"
+        else:
+            path, base = location.target, 0
+            try:
+                st = await asyncio.to_thread(os.stat, path)
+            except OSError:
+                return None
+            if st.st_size != chunksize:
+                return None
+            key = (path, 0, chunksize)
+            token = (st.st_size, st.st_mtime_ns)
+            if verified_memo.get(key) == token:
+                return (path, 0)
         cx = cluster.tunables.location_context()
         digest = await _hash_local_fused(chunk, location, cx, pipe)
         if digest is None:
             try:
                 digest = await pipe.run(
-                    "verify", lambda: _sha256_path(path),
+                    "verify",
+                    lambda: _sha256_extent(path, base, chunksize),
                     nbytes=chunksize)
             except OSError:
-                return False
+                return None
         if digest != chunk.hash.value.digest:
             # corrupt replica: a demerit for the node, and the generic
             # read path (which falls through / reconstructs) takes over
             health.record(location, False)
-            return False
-        verified_memo[path] = (st.st_size, st.st_mtime_ns)
-        while len(verified_memo) > _VERIFIED_MEMO_ENTRIES:
-            verified_memo.pop(next(iter(verified_memo)))
-        return True
+            return None
+        _memo_insert(key, token)
+        return (path, base)
 
     async def _sendfile_response(request: web.Request, status: int,
                                  headers: dict, path: str,
@@ -539,14 +585,17 @@ def make_app(cluster: Cluster,
                             and cache.contains(key))
                 if not in_cache:
                     for location in chunk.locations:
-                        if not location.is_local() \
+                        if not (location.is_local()
+                                or location.is_slab()) \
                                 or location.range.is_specified():
                             continue
-                        if await _verify_local_chunk(chunk, location,
-                                                     csize):
+                        served = await _verify_local_chunk(
+                            chunk, location, csize)
+                        if served is not None:
+                            path_, base = served
                             resp = await _sendfile_response(
                                 request, status, headers,
-                                location.target, off, length)
+                                path_, base + off, length)
                             if resp is not None:
                                 request["cb_source"] = "sendfile"
                                 return resp
@@ -650,8 +699,24 @@ def make_app(cluster: Cluster,
                 "source=%s", request.method, request.path, status,
                 nbytes, duration * 1000.0, source)
 
+    async def handle_scrub_status(request: web.Request) -> web.Response:
+        """Scrub observability: counters + running state as JSON.
+        ``enabled: false`` when no daemon is attached (the tunable is
+        off, or a multi-worker fleet where scrub runs as its own
+        ``chunky-bits scrub`` job instead of per worker)."""
+        request["cb_source"] = "meta"
+        if scrub is None:
+            payload = {"enabled": False}
+        else:
+            payload = {"enabled": True, **scrub.stats().to_obj()}
+        return web.json_response(payload)
+
     app = web.Application(middlewares=[access_log])
     app[PROFILER_KEY] = profiler
+    # registered before the catch-all: the status endpoint shadows an
+    # object literally named "scrub/status" (documented deviation — the
+    # reference's gateway has no non-object routes at all)
+    app.router.add_get("/scrub/status", handle_scrub_status)
     app.router.add_get("/{path:.*}", handle_get)  # also serves HEAD
     app.router.add_put("/{path:.*}", handle_put)
     return app
@@ -701,14 +766,28 @@ async def serve(cluster: Cluster, host: str = "127.0.0.1",
         from chunky_bits_tpu.analysis.sanitizer import get_monitor
 
         get_monitor().instrument_loop(asyncio.get_running_loop())
+    # continuous scrub rides the serving loop when the cluster's
+    # `scrub_bytes_per_sec` tunable asks for it (cluster/scrub.py;
+    # off = no daemon object at all).  Single-process serve only: a
+    # pre-forked fleet would otherwise run N identical namespace walks
+    # — multi-worker deployments run `chunky-bits scrub` as its own
+    # job, and every worker's /scrub/status says so (enabled: false).
+    scrub = None
+    if not reuse_port:
+        from chunky_bits_tpu.cluster.scrub import maybe_build
+
+        scrub = maybe_build(cluster)
     runner = web.AppRunner(
         make_app(cluster, max_put_bytes=max_put_bytes,
                  max_concurrent_puts=max_concurrent_puts,
                  min_put_rate=min_put_rate,
-                 max_concurrent_gets=max_concurrent_gets))
+                 max_concurrent_gets=max_concurrent_gets,
+                 scrub=scrub))
     await runner.setup()
     site = web.TCPSite(runner, host, port, reuse_port=reuse_port)
     await site.start()
+    if scrub is not None:
+        scrub.start()
     bound_port = port
     server = getattr(site, "_server", None)
     if server is not None and server.sockets:
@@ -722,4 +801,6 @@ async def serve(cluster: Cluster, host: str = "127.0.0.1",
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
+        if scrub is not None:
+            await scrub.stop()
         await runner.cleanup()

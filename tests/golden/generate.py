@@ -85,6 +85,29 @@ async def build_refs() -> dict[str, dict]:
             refs["cluster_placement"] = ref.to_obj()
         finally:
             os.chdir(cwd)
+
+    # 4. the same payload/weights over PACKED (slab:) destinations:
+    # pins the slab location serialization AND that the packed layout
+    # reproduces fixture 3's hash-seeded placement draw and content
+    # addresses exactly — the store changes where bytes live, never
+    # which bytes or which node
+    with tempfile.TemporaryDirectory() as tmp:
+        cwd = os.getcwd()
+        os.chdir(tmp)
+        try:
+            for i in range(5):
+                os.mkdir(f"d{i}")
+            os.mkdir("meta")
+            spec = cluster_spec("meta")
+            for node in spec["destinations"]:
+                node["location"] = "slab:" + node["location"]
+            cluster = Cluster.from_obj(spec)
+            profile = cluster.get_profile()
+            ref = await (cluster.get_file_writer(profile)
+                         .write(aio.BytesReader(payload(30_000, 3))))
+            refs["slab_placement"] = ref.to_obj()
+        finally:
+            os.chdir(cwd)
     return refs
 
 

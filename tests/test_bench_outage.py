@@ -110,6 +110,47 @@ def test_config9_failure_emits_one_json_line():
     assert "error" in rec
 
 
+def test_config10_smoke_emits_one_json_line():
+    """--config 10 --smoke (packed slab store vs file-per-chunk A/B at
+    CI scale) honors the driver contract: exactly one parseable JSON
+    line on stdout with the required keys plus the A/B fields, exit
+    0 — and the run itself asserts byte identity between layouts."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "10", "--smoke"],
+        cwd=REPO, env=env, capture_output=True, timeout=300)
+    assert r.returncode == 0, r.stderr.decode()[-800:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline",
+                "put_files_ops", "put_slab_ops", "get_files_ops",
+                "gc_walk_files_ms", "gc_walk_slab_ms",
+                "gc_walk_speedup"):
+        assert key in rec
+    assert rec["value"] > 0
+    assert rec["unit"] == "obj/s"
+
+
+def test_config10_failure_emits_one_json_line():
+    """ANY --config 10 failure (here: invalid parameters) still
+    produces exactly one parseable JSON line and exit 3 — the same
+    contract as configs 8/9 and the device runs."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "10",
+         "--objects", "0"],
+        cwd=REPO, env=env, capture_output=True, timeout=120)
+    assert r.returncode == 3, r.stderr.decode()[-500:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec
+    assert rec["value"] == 0.0
+    assert "error" in rec
+
+
 def test_seams_only_shrink_and_tolerate_garbage():
     """Inherited env values must not break the contract: malformed or
     larger-than-default values fall back to the real budget."""

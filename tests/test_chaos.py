@@ -241,6 +241,284 @@ def test_chaos_slow_location_hedged(tmp_path):
     asyncio.run(main())
 
 
+def test_chaos_slab_store_churn(tmp_path):
+    """The soak invariants over PACKED destinations (file/slab.py):
+    random write/overwrite/read/corrupt/delete/verify/resilver churn
+    with mid-churn compaction of every store.  Damage flips bytes
+    inside live slab extents or marks them dead — never more than p
+    per part — and reads must stay byte-identical throughout."""
+    from chunky_bits_tpu.file import slab
+
+    rng = np.random.default_rng(13)
+    root = tmp_path / "slabs"
+    dirs = []
+    for i in range(6):
+        d = root / f"disk{i}"
+        d.mkdir(parents=True)
+        dirs.append(str(d))
+    meta = root / "meta"
+    meta.mkdir()
+    cluster = Cluster.from_obj({
+        "destinations": [{"location": f"slab:{x}"} for x in dirs],
+        "metadata": {"type": "path", "format": "yaml", "path": str(meta)},
+        "profiles": {"default": {"data": 3, "parity": 2,
+                                 "chunk_size": 12}},
+    })
+
+    contents: dict[str, bytes] = {}
+    damaged: dict[str, set] = {}
+
+    async def op_write(name):
+        size = int(rng.integers(1, 50000))
+        payload = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        await cluster.write_file(name, aio.BytesReader(payload),
+                                 cluster.get_profile())
+        contents[name] = payload
+        damaged[name] = set()
+
+    async def op_read(name):
+        got = await cluster.file_read_builder(
+            await cluster.get_file_ref(name)).read_all()
+        assert got == contents[name], f"read mismatch for {name}"
+
+    async def op_damage(name, corrupt):
+        ref = await cluster.get_file_ref(name)
+        pi = int(rng.integers(0, len(ref.parts)))
+        part = ref.parts[pi]
+        chunks = part.data + part.parity
+        hurt = {c for (p_, c) in damaged[name] if p_ == pi}
+        if len(hurt) >= 2:  # p == 2: stay reconstructible
+            return
+        ci = int(rng.choice(
+            [c for c in range(len(chunks)) if c not in hurt]))
+        location = chunks[ci].locations[0]
+        ext = location.slab_extent()
+        if ext is None:
+            return  # shared content-addressed chunk already damaged
+        path, off, ln = ext
+        if corrupt:
+            with open(path, "r+b") as f:
+                at = off + int(rng.integers(0, ln))
+                f.seek(at)
+                byte = f.read(1)
+                f.seek(at)
+                f.write(bytes([byte[0] ^ 0x01]))
+        else:
+            await location.delete()
+        damaged[name].add((pi, ci))
+
+    async def op_resilver(name):
+        ref = await cluster.get_file_ref(name)
+        await ref.resilver(cluster.get_destination(cluster.get_profile()))
+        await cluster.write_file_ref(name, ref)
+        damaged[name] = set()
+        report = await (await cluster.get_file_ref(name)).verify()
+        assert report.integrity() == FileIntegrity.VALID
+        await op_read(name)
+
+    async def main():
+        await op_write("obj0")
+        for step in range(30):
+            name = list(contents)[int(rng.integers(0, len(contents)))]
+            op = rng.choice(["write", "overwrite", "read", "corrupt",
+                             "delete", "resilver", "compact"])
+            if op == "write":
+                await op_write(f"obj{len(contents)}")
+            elif op == "overwrite":
+                await op_write(name)
+            elif op == "read":
+                await op_read(name)
+            elif op == "corrupt":
+                await op_damage(name, corrupt=True)
+                await op_read(name)
+            elif op == "delete":
+                await op_damage(name, corrupt=False)
+                await op_read(name)
+            elif op == "resilver":
+                await op_resilver(name)
+            elif op == "compact":
+                # mid-churn compaction must preserve every live extent
+                # (dead ones are exactly the reclaimable set)
+                for d in dirs:
+                    await asyncio.to_thread(slab.get_store(d).compact)
+                await op_read(name)
+        for name in contents:
+            await op_resilver(name)
+
+    asyncio.run(main())
+
+
+def test_chaos_scrub_daemon_under_concurrent_churn(tmp_path):
+    """The scrub daemon runs (with rolling restarts) WHILE the cluster
+    churns: concurrent writes, deletes, mid-write corruption, and
+    resilver.  Afterwards every object reads byte-identical, a final
+    scrub pass leaves everything Valid, and the daemon stops cleanly —
+    under SANITIZE=1 the conftest additionally fails the session if
+    any scrub task leaked."""
+    from chunky_bits_tpu.cluster.scrub import ScrubDaemon
+
+    rng = np.random.default_rng(17)
+    root = tmp_path / "scrubbed"
+    dirs = []
+    for i in range(6):
+        d = root / f"disk{i}"
+        d.mkdir(parents=True)
+        dirs.append(str(d))
+    meta = root / "meta"
+    meta.mkdir()
+    cluster = Cluster.from_obj({
+        "destinations": [{"location": f"slab:{x}"} for x in dirs],
+        "metadata": {"type": "path", "format": "yaml", "path": str(meta)},
+        "profiles": {"default": {"data": 3, "parity": 2,
+                                 "chunk_size": 12}},
+    })
+    contents: dict[str, bytes] = {}
+
+    async def write(name):
+        size = int(rng.integers(1, 30000))
+        payload = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        await cluster.write_file(name, aio.BytesReader(payload),
+                                 cluster.get_profile())
+        contents[name] = payload
+
+    async def corrupt_one(name):
+        """Mid-churn corruption: flip a byte in one live extent (at
+        most one damaged chunk per object between repairs — p=2 keeps
+        it reconstructible even while the daemon races a resilver)."""
+        ref = await cluster.get_file_ref(name)
+        part = ref.parts[int(rng.integers(0, len(ref.parts)))]
+        chunk = part.data[int(rng.integers(0, len(part.data)))]
+        ext = chunk.locations[0].slab_extent()
+        if ext is None:
+            return
+        path, off, ln = ext
+        with open(path, "r+b") as f:
+            at = off + int(rng.integers(0, ln))
+            f.seek(at)
+            byte = f.read(1)
+            f.seek(at)
+            f.write(bytes([byte[0] ^ 0x10]))
+
+    async def main():
+        daemon = ScrubDaemon(cluster, bytes_per_sec=50_000_000,
+                             interval_seconds=0.01)
+        daemon.start()
+        try:
+            await write("obj0")
+            for step in range(14):
+                name = list(contents)[
+                    int(rng.integers(0, len(contents)))]
+                op = rng.choice(["write", "read", "corrupt",
+                                 "delete", "resilver", "restart"])
+                if op == "write":
+                    await write(f"obj{len(contents)}")
+                elif op == "read":
+                    got = await cluster.file_read_builder(
+                        await cluster.get_file_ref(name)).read_all()
+                    assert got == contents[name]
+                elif op == "corrupt":
+                    await corrupt_one(name)
+                elif op == "delete":
+                    ref = await cluster.get_file_ref(name)
+                    part = ref.parts[0]
+                    loc = part.parity[0].locations[0]
+                    try:
+                        await loc.delete()
+                    except Exception:  # noqa: BLE001 — the daemon may
+                        pass  # have repaired/deleted it concurrently
+                elif op == "resilver":
+                    ref = await cluster.get_file_ref(name)
+                    await ref.resilver(cluster.get_destination(
+                        cluster.get_profile()))
+                    await cluster.write_file_ref(name, ref)
+                elif op == "restart":
+                    # rolling restart: stop AND await, then start anew
+                    await daemon.stop()
+                    daemon.start()
+                await asyncio.sleep(0.005)
+            # quiesce churn; let the daemon repair remaining damage
+            deadline = asyncio.get_running_loop().time() + 30.0
+            while True:
+                ok = True
+                for name in contents:
+                    report = await (await cluster.get_file_ref(name)
+                                    ).verify()
+                    if report.integrity() != FileIntegrity.VALID:
+                        ok = False
+                if ok:
+                    break
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "scrub daemon never converged the namespace"
+                await asyncio.sleep(0.05)
+        finally:
+            await daemon.stop()
+        assert daemon.stats().passes >= 1
+        for name, payload in contents.items():
+            got = await cluster.file_read_builder(
+                await cluster.get_file_ref(name)).read_all()
+            assert got == payload, f"post-churn mismatch for {name}"
+
+    asyncio.run(main())
+
+
+def test_chaos_disk_full_on_one_slab_destination(tmp_path, monkeypatch):
+    """One packed destination returns ENOSPC on every append: writes
+    fail over to the surviving nodes (the writer invalidates the full
+    node), reads stay byte-identical, and once space returns a
+    resilver re-places onto the recovered node."""
+    import errno
+
+    from chunky_bits_tpu.file import slab
+
+    rng = np.random.default_rng(19)
+    root = tmp_path / "full"
+    dirs = []
+    for i in range(6):
+        d = root / f"disk{i}"
+        d.mkdir(parents=True)
+        dirs.append(str(d))
+    meta = root / "meta"
+    meta.mkdir()
+    cluster = Cluster.from_obj({
+        "destinations": [{"location": f"slab:{x}"} for x in dirs],
+        "metadata": {"type": "path", "format": "yaml", "path": str(meta)},
+        "profiles": {"default": {"data": 3, "parity": 2,
+                                 "chunk_size": 12}},
+    })
+    full_store = slab.get_store(dirs[0])
+
+    def out_of_space(name, data):
+        raise OSError(errno.ENOSPC, "No space left on device")
+
+    monkeypatch.setattr(full_store, "append", out_of_space)
+    payload = rng.integers(0, 256, 40000, dtype=np.uint8).tobytes()
+
+    async def main():
+        await cluster.write_file("obj", aio.BytesReader(payload),
+                                 cluster.get_profile())
+        ref = await cluster.get_file_ref("obj")
+        # nothing landed on the full node
+        for part in ref.parts:
+            for chunk in part.data + part.parity:
+                for location in chunk.locations:
+                    assert not location.target.startswith(dirs[0]), \
+                        f"chunk placed on the full node: {location}"
+        got = await cluster.file_read_builder(ref).read_all()
+        assert got == payload
+        # space returns: the node takes writes again on resilver
+        monkeypatch.undo()
+        await ref.parts[0].data[0].locations[0].delete()
+        report = await ref.resilver(
+            cluster.get_destination(cluster.get_profile()))
+        assert not report.failed_writes(), report.failed_writes()
+        await cluster.write_file_ref("obj", ref)
+        got = await cluster.file_read_builder(
+            await cluster.get_file_ref("obj")).read_all()
+        assert got == payload
+
+    asyncio.run(main())
+
+
 def test_chaos_soak_http_nodes(tmp_path):
     """The same invariants over in-process HTTP storage nodes: damage is
     dropped/corrupted in the node stores, repair re-places over HTTP."""

@@ -24,12 +24,29 @@ def golden_text(name: str) -> str:
 
 def test_fixtures_match_current_behavior():
     refs = asyncio.run(gen.build_refs())
-    assert set(refs) == {"void_small", "void_wide", "cluster_placement"}
+    assert set(refs) == {"void_small", "void_wide", "cluster_placement",
+                         "slab_placement"}
     for name, obj in refs.items():
         assert gen.dump(obj) == golden_text(name), (
             f"golden fixture {name} drifted — wire compatibility broken "
             "(or an intentional change: regenerate via "
             "tests/golden/generate.py and document it)")
+
+
+def test_slab_fixture_mirrors_path_placement():
+    """Fixture 4 differs from fixture 3 ONLY in the ``slab:`` location
+    scheme: same content addresses, same hash-seeded node draw — the
+    packed layout is a storage format, not a placement change."""
+    import yaml
+
+    plain = yaml.safe_load(golden_text("cluster_placement"))
+    packed = yaml.safe_load(golden_text("slab_placement"))
+    for p_part, s_part in zip(plain["parts"], packed["parts"]):
+        for p_chunk, s_chunk in zip(p_part["data"] + p_part["parity"],
+                                    s_part["data"] + s_part["parity"]):
+            assert p_chunk["sha256"] == s_chunk["sha256"]
+            assert [f"slab:{loc}" for loc in p_chunk["locations"]] \
+                == s_chunk["locations"]
 
 
 @pytest.mark.parametrize("backend", ["numpy", "native", "jax"])

@@ -1,0 +1,446 @@
+"""Continuous scrub/repair daemon (cluster/scrub.py).
+
+Pins the acceptance criteria: the byte-rate bound is honored (measured),
+an injected flipped bit is detected, the serving node is demerited in
+the health scoreboard, the damaged part is repaired in place, the
+daemon is off by default with zero overhead when off, and start/stop
+leaks nothing (the SANITIZE=1 tier-1 leg re-runs this whole file under
+the task-leak registry).
+"""
+
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+from chunky_bits_tpu.cluster import Cluster
+from chunky_bits_tpu.cluster.scrub import ScrubDaemon, TokenBucket, \
+    maybe_build
+from chunky_bits_tpu.utils import aio
+from tests.test_slab import make_cluster_obj
+
+
+def write_payload(cluster, name, nbytes, seed=0):
+    payload = np.random.default_rng(seed).integers(
+        0, 256, nbytes, dtype=np.uint8).tobytes()
+
+    async def run():
+        await cluster.write_file(name, aio.BytesReader(payload),
+                                 cluster.get_profile())
+
+    asyncio.run(run())
+    return payload
+
+
+def flip_bit_in_extent(location, at=11):
+    path, off, ln = location.slab_extent()
+    with open(path, "r+b") as f:
+        f.seek(off + min(at, ln - 1))
+        byte = f.read(1)
+        f.seek(off + min(at, ln - 1))
+        f.write(bytes([byte[0] ^ 1]))
+
+
+# ---- token bucket ----
+
+def test_token_bucket_honors_rate():
+    bucket = TokenBucket(rate=40_000)
+
+    async def main():
+        t0 = time.monotonic()
+        # 60 KB against a 40 KB/s rate with a 40 KB burst: at least
+        # (60-40)/40 = 0.5 s must elapse
+        for _ in range(6):
+            await bucket.take(10_000)
+        return time.monotonic() - t0
+
+    elapsed = asyncio.run(main())
+    assert elapsed >= 0.4, f"bucket let 60KB through in {elapsed:.3f}s"
+    assert elapsed < 5.0
+
+
+def test_token_bucket_zero_rate_is_unbounded():
+    bucket = TokenBucket(rate=0)
+
+    async def main():
+        t0 = time.monotonic()
+        for _ in range(100):
+            await bucket.take(1 << 20)
+        return time.monotonic() - t0
+
+    assert asyncio.run(main()) < 0.5
+
+
+# ---- off by default ----
+
+def test_daemon_off_by_default(tmp_path):
+    cluster = Cluster.from_obj(make_cluster_obj(tmp_path))
+    assert cluster.tunables.scrub_bytes_per_sec == 0
+    assert maybe_build(cluster) is None
+
+
+def test_tunable_serde_and_env_default(tmp_path, monkeypatch):
+    from chunky_bits_tpu.cluster.tunables import (
+        SCRUB_BYTES_PER_SEC_ENV,
+        Tunables,
+    )
+
+    t = Tunables.from_obj({"scrub_bytes_per_sec": 1048576})
+    assert t.scrub_bytes_per_sec == 1048576
+    assert t.to_obj()["scrub_bytes_per_sec"] == 1048576
+    assert "scrub_bytes_per_sec" not in Tunables.from_obj(None).to_obj()
+    with pytest.raises(Exception):
+        Tunables.from_obj({"scrub_bytes_per_sec": -5})
+    monkeypatch.setenv(SCRUB_BYTES_PER_SEC_ENV, "2048")
+    assert Tunables.from_obj(None).scrub_bytes_per_sec == 2048
+    monkeypatch.setenv(SCRUB_BYTES_PER_SEC_ENV, "garbage")
+    assert Tunables.from_obj(None).scrub_bytes_per_sec == 0
+    # YAML wins over the env default
+    assert Tunables.from_obj(
+        {"scrub_bytes_per_sec": 7}).scrub_bytes_per_sec == 7
+    cluster = Cluster.from_obj(make_cluster_obj(
+        tmp_path, tunables={"scrub_bytes_per_sec": 4096}))
+    daemon = maybe_build(cluster)
+    assert daemon is not None and daemon.rate == 4096
+
+
+# ---- detection / demerit / repair ----
+
+def test_scrub_detects_demerits_and_repairs(tmp_path):
+    cluster = Cluster.from_obj(make_cluster_obj(tmp_path))
+    payload = write_payload(cluster, "a/obj", 30000, seed=1)
+    write_payload(cluster, "b", 9000, seed=2)
+
+    async def main():
+        ref = await cluster.get_file_ref("a/obj")
+        bad_location = ref.parts[0].data[0].locations[0]
+        flip_bit_in_extent(bad_location)
+        daemon = ScrubDaemon(cluster, bytes_per_sec=10_000_000)
+        stats = await daemon.run_once()
+        assert stats.files_scanned == 2
+        assert stats.corrupt >= 1
+        assert stats.repaired >= 1
+        assert stats.bytes_verified > 0
+        # the node serving corrupt bytes took a health demerit
+        health = cluster.health_scoreboard().stats()
+        assert any(row.errors >= 1 for row in health.locations), health
+        # repaired: the object verifies Valid and reads identical
+        ref2 = await cluster.get_file_ref("a/obj")
+        verify = await ref2.verify(cluster.tunables.location_context())
+        assert str(verify.integrity()) == "Valid"
+        got = await cluster.file_read_builder(ref2).read_all()
+        assert got == payload
+        # the Scrub<...> stanza renders through the profiler
+        from chunky_bits_tpu.file.profiler import new_profiler
+
+        profiler, reporter = new_profiler()
+        profiler.attach_scrub(daemon)
+        assert "Scrub<" in str(reporter.profile())
+
+    asyncio.run(main())
+
+
+def test_scrub_repairs_missing_extent(tmp_path):
+    cluster = Cluster.from_obj(make_cluster_obj(tmp_path))
+    payload = write_payload(cluster, "obj", 24000, seed=3)
+
+    async def main():
+        ref = await cluster.get_file_ref("obj")
+        await ref.parts[0].parity[0].locations[0].delete()
+        daemon = ScrubDaemon(cluster, bytes_per_sec=0)  # unthrottled
+        stats = await daemon.run_once()
+        assert stats.unavailable >= 1
+        assert stats.repaired >= 1
+        ref2 = await cluster.get_file_ref("obj")
+        verify = await ref2.verify(cluster.tunables.location_context())
+        assert str(verify.integrity()) == "Valid"
+        got = await cluster.file_read_builder(ref2).read_all()
+        assert got == payload
+
+    asyncio.run(main())
+
+
+def test_scrub_no_repair_mode_reports_only(tmp_path):
+    cluster = Cluster.from_obj(make_cluster_obj(tmp_path))
+    write_payload(cluster, "obj", 15000, seed=4)
+
+    async def main():
+        ref = await cluster.get_file_ref("obj")
+        flip_bit_in_extent(ref.parts[0].data[0].locations[0])
+        daemon = ScrubDaemon(cluster, bytes_per_sec=0, repair=False)
+        stats = await daemon.run_once()
+        assert stats.corrupt >= 1
+        assert stats.repaired == 0
+        # still corrupt: a second pass finds it again
+        stats = await daemon.run_once()
+        assert stats.corrupt >= 2
+
+    asyncio.run(main())
+
+
+def test_scrub_rate_bound_measured(tmp_path):
+    """The acceptance measurement: with ~45 KB of replicas and a
+    30 KB/s budget (30 KB burst), one pass cannot finish faster than
+    (bytes - burst) / rate."""
+    cluster = Cluster.from_obj(make_cluster_obj(tmp_path))
+    write_payload(cluster, "obj", 27000, seed=5)
+
+    async def main():
+        daemon = ScrubDaemon(cluster, bytes_per_sec=30_000)
+        t0 = time.monotonic()
+        stats = await daemon.run_once()
+        elapsed = time.monotonic() - t0
+        floor = (stats.bytes_verified - 30_000) / 30_000
+        assert floor > 0.1, \
+            f"scenario too small to measure ({stats.bytes_verified}B)"
+        assert elapsed >= floor * 0.9, (
+            f"pass took {elapsed:.3f}s for {stats.bytes_verified}B — "
+            f"the 30KB/s bound requires >= {floor:.3f}s")
+
+    asyncio.run(main())
+
+
+def test_scrub_prioritizes_degraded_nodes_first(tmp_path):
+    cluster = Cluster.from_obj(make_cluster_obj(tmp_path))
+    write_payload(cluster, "healthy", 12000, seed=6)
+    write_payload(cluster, "atrisk", 12000, seed=7)
+
+    async def main():
+        ref = await cluster.get_file_ref("atrisk")
+        victim = ref.parts[0].data[0].locations[0]
+        health = cluster.health_scoreboard()
+        for _ in range(6):  # trip the breaker: node degraded
+            health.record(victim, False)
+        assert health.degraded(victim)
+        daemon = ScrubDaemon(cluster, bytes_per_sec=0)
+        order = []
+        original = daemon._scrub_ref
+
+        async def spy(path, ref, cx, pipe, snapshot):
+            order.append(path)
+            return await original(path, ref, cx, pipe, snapshot)
+
+        daemon._scrub_ref = spy
+        await daemon.run_once()
+        assert order[0] == "atrisk", order
+
+    asyncio.run(main())
+
+
+def test_scrub_rewrites_corrupt_replica_beside_healthy_one(tmp_path):
+    """A corrupt replica with a healthy sibling is overwritten in
+    place (resilver only rebuilds chunks with NO valid replica) — the
+    namespace CONVERGES instead of re-detecting the same rot, and
+    re-demeriting the node, every pass forever."""
+    from chunky_bits_tpu.file.location import Location
+
+    cluster = Cluster.from_obj(make_cluster_obj(tmp_path))
+    payload = write_payload(cluster, "obj", 21000, seed=30)
+
+    async def main():
+        ref = await cluster.get_file_ref("obj")
+        chunk = ref.parts[0].data[0]
+        # replicate the chunk onto a second node, then corrupt the
+        # original replica
+        data = await chunk.locations[0].read()
+        victim_root = os.path.dirname(chunk.locations[0].target)
+        other = next(d for d in
+                     (os.path.join(str(tmp_path), f"disk{i}")
+                      for i in range(5))
+                     if d != victim_root)
+        replica = Location.parse(f"slab:{other}/{chunk.hash}")
+        await replica.write(bytes(data))
+        chunk.locations.append(replica)
+        await cluster.write_file_ref("obj", ref)
+        flip_bit_in_extent(chunk.locations[0])
+        daemon = ScrubDaemon(cluster, bytes_per_sec=0)
+        stats1 = await daemon.run_once()
+        assert stats1.corrupt == 1
+        assert stats1.repaired >= 1
+        # converged: the next pass finds NOTHING new
+        stats2 = await daemon.run_once()
+        assert stats2.corrupt == stats1.corrupt, \
+            "corrupt replica re-detected — scrub never converges"
+        ref2 = await cluster.get_file_ref("obj")
+        verify = await ref2.verify(cluster.tunables.location_context())
+        assert str(verify.integrity()) == "Valid"
+        got = await cluster.file_read_builder(ref2).read_all()
+        assert got == payload
+
+    asyncio.run(main())
+
+
+def test_daemon_survives_a_failing_pass(tmp_path):
+    """An unexpected exception inside one pass is logged and retried —
+    it must not silently end continuous scrubbing, and stop() must
+    still return cleanly afterwards."""
+    cluster = Cluster.from_obj(make_cluster_obj(tmp_path))
+    write_payload(cluster, "obj", 9000, seed=31)
+
+    async def main():
+        daemon = ScrubDaemon(cluster, bytes_per_sec=0,
+                             interval_seconds=0.01)
+        real_run_once = daemon.run_once
+        calls = {"n": 0}
+
+        async def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("injected pass failure")
+            return await real_run_once()
+
+        daemon.run_once = flaky
+        daemon.start()
+        deadline = time.monotonic() + 10.0
+        while daemon.stats().passes < 1:
+            assert time.monotonic() < deadline, \
+                "daemon died on the failing pass"
+            await asyncio.sleep(0.02)
+        assert calls["n"] >= 2
+        await daemon.stop()
+        assert not daemon.stats().running
+
+    asyncio.run(main())
+
+
+def test_scrub_repair_never_clobbers_concurrent_overwrite(tmp_path):
+    """The republish fence: a client overwrite landing WHILE the
+    (rate-bounded) scrub pass holds the old ref must win — the repair
+    may fix old chunks, but stale metadata is never written back over
+    the new version."""
+    cluster = Cluster.from_obj(make_cluster_obj(tmp_path))
+    write_payload(cluster, "obj", 27000, seed=20)
+
+    async def main():
+        ref = await cluster.get_file_ref("obj")
+        flip_bit_in_extent(ref.parts[0].data[0].locations[0])
+        # ~45 KB of replicas against 30 KB/s: the pass holds obj's
+        # metadata snapshot for >= ~0.5 s after reading it
+        daemon = ScrubDaemon(cluster, bytes_per_sec=30_000)
+        pass_task = asyncio.ensure_future(daemon.run_once())
+        await asyncio.sleep(0.15)
+        new_payload = np.random.default_rng(21).integers(
+            0, 256, 5000, dtype=np.uint8).tobytes()
+        await cluster.write_file("obj", aio.BytesReader(new_payload),
+                                 cluster.get_profile())
+        await pass_task
+        got = await cluster.file_read_builder(
+            await cluster.get_file_ref("obj")).read_all()
+        assert got == new_payload, \
+            "scrub republished a stale ref over a concurrent overwrite"
+
+    asyncio.run(main())
+
+
+# ---- daemon lifetime ----
+
+def test_daemon_start_stop_leaks_nothing(tmp_path):
+    cluster = Cluster.from_obj(make_cluster_obj(tmp_path))
+    write_payload(cluster, "obj", 9000, seed=8)
+
+    async def main():
+        daemon = ScrubDaemon(cluster, bytes_per_sec=10_000_000,
+                             interval_seconds=0.02)
+        daemon.start()
+        assert daemon.stats().running
+        daemon.start()  # idempotent while running
+        deadline = time.monotonic() + 10.0
+        while daemon.stats().passes < 2:
+            assert time.monotonic() < deadline, "no passes completed"
+            await asyncio.sleep(0.02)
+        await daemon.stop()
+        assert not daemon.stats().running
+        await daemon.stop()  # idempotent when stopped
+        passes = daemon.stats().passes
+        await asyncio.sleep(0.1)
+        assert daemon.stats().passes == passes, "daemon survived stop()"
+
+    asyncio.run(main())
+
+
+# ---- gateway integration ----
+
+def test_gateway_scrub_status_and_autostart(tmp_path):
+    """serve() with the tunable set starts the daemon and exposes its
+    counters at /scrub/status; with the tunable off the endpoint says
+    enabled: false (pinned separately in the gateway sendfile test)."""
+    from aiohttp import ClientSession
+
+    from chunky_bits_tpu.gateway import serve
+
+    cluster = Cluster.from_obj(make_cluster_obj(
+        tmp_path, tunables={"scrub_bytes_per_sec": 10_000_000}))
+    payload = write_payload(cluster, "obj", 20000, seed=9)
+
+    async def main():
+        ready: asyncio.Future = asyncio.get_running_loop() \
+            .create_future()
+        serve_task = asyncio.ensure_future(serve(
+            cluster, "127.0.0.1", 0,
+            on_ready=lambda port: ready.set_result(port)))
+        port = await asyncio.wait_for(ready, 30)
+        try:
+            async with ClientSession() as session:
+                url = f"http://127.0.0.1:{port}"
+                deadline = time.monotonic() + 15.0
+                while True:
+                    resp = await session.get(f"{url}/scrub/status")
+                    assert resp.status == 200
+                    status = await resp.json()
+                    assert status["enabled"] is True
+                    if status["passes"] >= 1:
+                        break
+                    assert time.monotonic() < deadline, status
+                    await asyncio.sleep(0.05)
+                assert status["files_scanned"] >= 1
+                assert status["corrupt"] == 0
+                # object reads ride alongside the scrub
+                resp = await session.get(f"{url}/obj")
+                assert await resp.read() == payload
+        finally:
+            serve_task.cancel()
+            await asyncio.gather(serve_task, return_exceptions=True)
+
+    asyncio.run(main())
+
+
+# ---- CLI ----
+
+def test_cli_scrub_once(tmp_path):
+    import subprocess
+    import sys
+
+    import yaml
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    obj = make_cluster_obj(tmp_path)
+    cluster_path = tmp_path / "cluster.yaml"
+    cluster_path.write_text(yaml.safe_dump(obj))
+    cluster = Cluster.from_obj(obj)
+    write_payload(cluster, "obj", 18000, seed=10)
+
+    async def corrupt():
+        ref = await cluster.get_file_ref("obj")
+        flip_bit_in_extent(ref.parts[0].data[0].locations[0])
+
+    asyncio.run(corrupt())
+    env = dict(os.environ)
+    env.setdefault("PYTHONPATH", REPO)
+    result = subprocess.run(
+        [sys.executable, "-m", "chunky_bits_tpu.cli", "scrub",
+         "--once", str(cluster_path)],
+        capture_output=True, env=env, cwd=REPO, timeout=120)
+    assert result.returncode == 0, result.stderr.decode()
+    out = result.stdout.decode()
+    assert "Scrub<" in out and "corrupt=1" in out, out
+    assert "repaired=1" in out, out
+
+    async def check():
+        fresh = Cluster.from_obj(obj)
+        ref = await fresh.get_file_ref("obj")
+        verify = await ref.verify(fresh.tunables.location_context())
+        assert str(verify.integrity()) == "Valid"
+
+    asyncio.run(check())

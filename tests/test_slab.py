@@ -1,0 +1,498 @@
+"""Packed slab chunk store (file/slab.py) + the ``slab:`` Location kind.
+
+Pins the tentpole contracts: the Location surface is byte-identical to
+path destinations across backends (the writer/reader/resilver/gateway
+call sites change nothing), publication is journal-atomic (torn tails
+never corrupt, crashed writers never publish), GC marks extents dead
+and compaction reclaims them, and the gateway's zero-copy branch
+streams in-slab extents via sendfile with the reassembly fallback on
+corruption.
+"""
+
+import asyncio
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from chunky_bits_tpu.cluster import Cluster
+from chunky_bits_tpu.errors import LocationError
+from chunky_bits_tpu.file import slab
+from chunky_bits_tpu.file.location import Location, LocationContext, Range
+from chunky_bits_tpu.file.weighted_location import WeightedLocation
+from chunky_bits_tpu.utils import aio
+
+
+def make_cluster_obj(root, packed=True, d=3, p=2, chunk_log2=12,
+                     n_nodes=5, tunables=None):
+    dirs = []
+    for i in range(n_nodes):
+        path = os.path.join(str(root), f"disk{i}")
+        os.makedirs(path, exist_ok=True)
+        dirs.append(f"slab:{path}" if packed else path)
+    meta = os.path.join(str(root), "meta")
+    os.makedirs(meta, exist_ok=True)
+    obj = {
+        "destinations": [{"location": x} for x in dirs],
+        "metadata": {"type": "path", "format": "yaml", "path": meta},
+        "profiles": {"default": {"data": d, "parity": p,
+                                 "chunk_size": chunk_log2}},
+    }
+    if tunables:
+        obj["tunables"] = tunables
+    return obj
+
+
+# ---- parsing / hierarchy ----
+
+def test_parse_roundtrip_and_hierarchy(tmp_path):
+    root = str(tmp_path / "store")
+    loc = Location.parse(f"slab:{root}")
+    assert loc.is_slab() and not loc.is_local() and not loc.is_http()
+    assert str(loc) == f"slab:{root}"
+    child = loc.child("sha256-ab")
+    assert str(child) == f"slab:{root}/sha256-ab"
+    assert child.is_child_of(loc) and loc.is_parent_of(child)
+    assert Location.parse(str(child)) == child
+    ranged = Location.parse(f"(5,10)slab:{root}/sha256-ab")
+    assert ranged.range == Range(5, 10, False)
+    assert ranged.target == f"{root}/sha256-ab"
+    assert str(ranged) == f"(5,10)slab:{root}/sha256-ab"
+    # weighted-location prefix composes
+    wl = WeightedLocation.parse(f"750:slab:{root}")
+    assert wl.weight == 750 and wl.location.is_slab()
+    with pytest.raises(Exception):
+        Location.parse("slab:")
+
+
+def test_health_key_is_store_root(tmp_path):
+    from chunky_bits_tpu.cluster.health import location_key
+
+    child = Location.parse(f"slab:{tmp_path}/store/sha256-ab")
+    assert location_key(child) == ("local", f"{tmp_path}/store")
+
+
+# ---- store mechanics ----
+
+def test_store_append_lookup_delete_reload(tmp_path):
+    root = str(tmp_path / "s")
+    store = slab.SlabStore(root)
+    ext = store.append("sha256-aa", b"A" * 100)
+    store.append("sha256-bb", b"B" * 50)
+    assert ext.offset == 0 and ext.length == 100
+    assert store.pread("sha256-aa") == b"A" * 100
+    assert store.pread("sha256-bb", 10, 5) == b"B" * 5
+    assert store.lookup("sha256-cc") is None
+    # a second instance over the same root sees the journal
+    other = slab.SlabStore(root)
+    assert other.pread("sha256-bb") == b"B" * 50
+    # delete marks dead; the other instance observes it on refresh
+    store.mark_dead("sha256-aa")
+    assert store.lookup("sha256-aa") is None
+    assert store.dead_bytes() == 100
+    assert other.lookup("sha256-aa") is None
+    with pytest.raises(FileNotFoundError):
+        store.mark_dead("sha256-aa")
+    with pytest.raises(FileNotFoundError):
+        store.pread("sha256-zz")
+
+
+def test_supersede_marks_old_extent_dead(tmp_path):
+    store = slab.SlabStore(str(tmp_path / "s"))
+    store.append("sha256-aa", b"old-bytes!")
+    store.append("sha256-aa", b"new")
+    assert store.pread("sha256-aa") == b"new"
+    assert store.dead_bytes() == 10
+
+
+def test_torn_journal_tail_is_ignored_and_repaired(tmp_path):
+    root = str(tmp_path / "s")
+    store = slab.SlabStore(root)
+    store.append("sha256-aa", b"AAAA")
+    # simulate a crash mid-journal-append: a torn, newline-less tail
+    with open(store.journal_path(), "ab") as f:
+        f.write(b'{"o":"p","n":"sha256-torn","s":"sl')
+    fresh = slab.SlabStore(root)
+    assert fresh.live_names() == ["sha256-aa"]
+    assert fresh.lookup("sha256-torn") is None
+    # the next append terminates the fragment; nothing merges into it
+    fresh.append("sha256-bb", b"BBBB")
+    again = slab.SlabStore(root)
+    assert sorted(again.live_names()) == ["sha256-aa", "sha256-bb"]
+    assert again.pread("sha256-bb") == b"BBBB"
+
+
+def test_unreferenced_slab_tail_is_invisible(tmp_path):
+    """A crash between the slab append and the journal commit leaves
+    tail bytes no journal line references: no reader ever sees them."""
+    root = str(tmp_path / "s")
+    store = slab.SlabStore(root)
+    store.append("sha256-aa", b"AAAA")
+    with open(store.slab_path("slab-000001.slab"), "ab") as f:
+        f.write(b"CRASHED-WRITER-BYTES")
+    fresh = slab.SlabStore(root)
+    assert fresh.live_names() == ["sha256-aa"]
+    assert fresh.pread("sha256-aa") == b"AAAA"
+    # the next publication appends after the orphan bytes and reads back
+    fresh.append("sha256-bb", b"BBBB")
+    assert fresh.pread("sha256-bb") == b"BBBB"
+
+
+def test_rollover_past_slab_max_bytes(tmp_path):
+    store = slab.SlabStore(str(tmp_path / "s"), slab_max_bytes=100)
+    for i in range(6):
+        store.append(f"sha256-{i:02d}", bytes([i]) * 40)
+    assert len(store.slab_files()) >= 2
+    for i in range(6):
+        assert store.pread(f"sha256-{i:02d}") == bytes([i]) * 40
+
+
+def test_compact_reclaims_and_preserves(tmp_path):
+    store = slab.SlabStore(str(tmp_path / "s"), slab_max_bytes=200)
+    payloads = {f"sha256-{i:02d}": os.urandom(50) for i in range(8)}
+    for name, data in payloads.items():
+        store.append(name, data)
+    # hold a zero-copy view across the compaction: the old inode must
+    # stay readable for the view's lifetime (atomic-rename semantics)
+    held = store.map_view("sha256-03")
+    for name in ("sha256-00", "sha256-05"):
+        store.mark_dead(name)
+        del payloads[name]
+    report = store.compact()
+    assert report["reclaimed_bytes"] == 100
+    assert report["live_chunks"] == len(payloads)
+    for name, data in payloads.items():
+        assert store.pread(name) == data
+    assert store.dead_bytes() == 0
+    assert bytes(held) == payloads["sha256-03"]
+    # another instance reloads the swapped journal cleanly
+    fresh = slab.SlabStore(str(tmp_path / "s"))
+    assert sorted(fresh.live_names()) == sorted(payloads)
+
+
+def test_concurrent_appends_from_two_instances(tmp_path):
+    """Two store instances over one root (the cross-process shape in
+    miniature): flock-serialized appends from concurrent threads all
+    publish, and both indexes converge."""
+    root = str(tmp_path / "s")
+    a, b = slab.SlabStore(root), slab.SlabStore(root)
+    errors = []
+
+    def writer(store, prefix):
+        try:
+            for i in range(20):
+                store.append(f"sha256-{prefix}{i:02d}",
+                             f"{prefix}{i}".encode() * 10)
+        except Exception as err:  # noqa: BLE001 — surfaced via errors
+            errors.append(err)
+
+    threads = [threading.Thread(target=writer, args=(a, "a"), daemon=True),
+               threading.Thread(target=writer, args=(b, "b"), daemon=True)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors, errors
+    assert len(a.live_names()) == 40
+    assert len(b.live_names()) == 40
+    assert a.pread("sha256-b07") == b"b7" * 10
+
+
+# ---- Location surface over the store ----
+
+def test_location_verbs_roundtrip(tmp_path):
+    loc = Location.parse(f"slab:{tmp_path}/store").child("sha256-xy")
+
+    async def main():
+        assert not await loc.file_exists()
+        with pytest.raises(LocationError):
+            await loc.file_len()
+        with pytest.raises(LocationError):
+            await loc.read()
+        await loc.write(b"0123456789" * 10)
+        assert await loc.file_exists()
+        assert await loc.file_len() == 100
+        assert await loc.read() == b"0123456789" * 10
+        # ranged reads mirror local-file semantics
+        assert await loc.with_range(Range(95)).read() == b"56789"
+        assert await loc.with_range(Range(4, 3)).read() == b"456"
+        assert await loc.with_range(Range(95, 10)).read() == b"56789"
+        zext = await loc.with_range(Range(95, 10, True)).read()
+        assert zext == b"56789" + b"\0" * 5
+        # zero-copy view agrees
+        view = await loc.read_view()
+        assert bytes(view) == b"0123456789" * 10
+        rview = await loc.with_range(Range(4, 3)).read_view()
+        assert bytes(rview) == b"456"
+        # streaming write path (write_from_reader)
+        sibling = Location.parse(f"slab:{tmp_path}/store/sha256-zz")
+        n = await sibling.write_from_reader(
+            aio.BytesReader(b"stream-bytes"))
+        assert n == 12
+        assert await sibling.read() == b"stream-bytes"
+        # IGNORE conflict: a second write of the same name is a no-op
+        cx = LocationContext(on_conflict="ignore")
+        await sibling.write(b"different", cx)
+        assert await sibling.read() == b"stream-bytes"
+        await sibling.delete()
+        assert not await sibling.file_exists()
+        with pytest.raises(LocationError):
+            await sibling.delete()
+
+    asyncio.run(main())
+
+
+def test_write_shard_places_into_store(tmp_path):
+    from chunky_bits_tpu.file.hashing import AnyHash
+
+    root_loc = Location.parse(f"slab:{tmp_path}/store")
+
+    async def main():
+        data = b"shard-payload" * 9
+        hash_ = AnyHash.from_buf(data)
+        locations = await root_loc.write_shard(hash_, data)
+        assert len(locations) == 1 and locations[0].is_slab()
+        assert await locations[0].read() == data
+        store = slab.get_store(f"{tmp_path}/store")
+        assert store.live_names() == [str(hash_)]
+
+    asyncio.run(main())
+
+
+# ---- byte identity across backends / erasure ----
+
+@pytest.mark.parametrize("backend", ["numpy", "native", "jax"])
+def test_byte_identity_vs_path_destinations(tmp_path, backend):
+    """Same payload through a slab cluster and a path cluster on each
+    backend: reads match, and the content-addressed chunk digests are
+    identical between layouts (the store changes placement, never
+    bytes)."""
+    if backend == "jax":
+        pytest.importorskip("jax")
+    payload = np.random.default_rng(5).integers(
+        0, 256, 40000, dtype=np.uint8).tobytes()
+
+    async def run(packed):
+        cluster = Cluster.from_obj(make_cluster_obj(
+            tmp_path / ("slab" if packed else "files"), packed=packed,
+            tunables={"backend": backend}))
+        await cluster.write_file("obj", aio.BytesReader(payload),
+                                 cluster.get_profile())
+        ref = await cluster.get_file_ref("obj")
+        got = await cluster.file_read_builder(ref).read_all()
+        assert got == payload
+        return [str(c.hash) for part in ref.parts
+                for c in part.data + part.parity]
+
+    packed_hashes = asyncio.run(run(True))
+    plain_hashes = asyncio.run(run(False))
+    assert packed_hashes == plain_hashes
+
+
+def test_reconstruct_from_erased_extents(tmp_path):
+    payload = np.random.default_rng(6).integers(
+        0, 256, 60000, dtype=np.uint8).tobytes()
+
+    async def main():
+        cluster = Cluster.from_obj(make_cluster_obj(tmp_path))
+        await cluster.write_file("obj", aio.BytesReader(payload),
+                                 cluster.get_profile())
+        ref = await cluster.get_file_ref("obj")
+        # erase p extents per part (the reconstructible maximum)
+        for part in ref.parts:
+            await part.data[0].locations[0].delete()
+            await part.parity[0].locations[0].delete()
+        got = await cluster.file_read_builder(ref).read_all()
+        assert got == payload
+        # resilver repairs in place; everything verifies Valid after
+        report = await ref.resilver(
+            cluster.get_destination(cluster.get_profile()))
+        assert not report.failed_writes(), report.failed_writes()
+        await cluster.write_file_ref("obj", ref)
+        verify = await ref.verify(cluster.tunables.location_context())
+        assert str(verify.integrity()) == "Valid"
+        got = await cluster.file_read_builder(ref).read_all()
+        assert got == payload
+
+    asyncio.run(main())
+
+
+def test_corrupt_extent_falls_through_to_replica_or_rebuild(tmp_path):
+    payload = np.random.default_rng(7).integers(
+        0, 256, 30000, dtype=np.uint8).tobytes()
+
+    async def main():
+        cluster = Cluster.from_obj(make_cluster_obj(tmp_path))
+        await cluster.write_file("obj", aio.BytesReader(payload),
+                                 cluster.get_profile())
+        ref = await cluster.get_file_ref("obj")
+        loc = ref.parts[0].data[1].locations[0]
+        path, off, ln = loc.slab_extent()
+        with open(path, "r+b") as f:
+            f.seek(off + ln // 3)
+            byte = f.read(1)
+            f.seek(off + ln // 3)
+            f.write(bytes([byte[0] ^ 0x40]))
+        got = await cluster.file_read_builder(ref).read_all()
+        assert got == payload
+
+    asyncio.run(main())
+
+
+# ---- gateway integration ----
+
+def test_gateway_sendfile_over_slab_extents(tmp_path):
+    """A Range inside one packed chunk streams via the zero-copy branch
+    (access log source == "sendfile") with byte identity; a corrupted
+    extent demotes to the reassembly fallback, still byte-identical."""
+    from aiohttp import ClientSession
+    from aiohttp.test_utils import TestServer
+
+    from chunky_bits_tpu.gateway import make_app
+    from chunky_bits_tpu.gateway.http import PROFILER_KEY
+
+    payload = np.random.default_rng(8).integers(
+        0, 256, 3 * 16384 + 777, dtype=np.uint8).tobytes()
+
+    async def main():
+        cluster = Cluster.from_obj(
+            make_cluster_obj(tmp_path, chunk_log2=14))
+        await cluster.write_file("obj", aio.BytesReader(payload),
+                                 cluster.get_profile())
+        app = make_app(cluster)
+        server = TestServer(app)
+        await server.start_server()
+        profiler = app[PROFILER_KEY]
+        try:
+            async with ClientSession() as session:
+                resp = await session.get(server.make_url("/obj"))
+                assert await resp.read() == payload
+                resp = await session.get(
+                    server.make_url("/obj"),
+                    headers={"Range": "bytes=128-2175"})
+                assert resp.status == 206
+                assert await resp.read() == payload[128:2176]
+                # memoized second hit stays identical
+                resp = await session.get(
+                    server.make_url("/obj"),
+                    headers={"Range": "bytes=200-300"})
+                assert await resp.read() == payload[200:301]
+                await asyncio.sleep(0.05)  # let access-log finallys run
+                entries = profiler.drain_requests()
+                sendfile = [e for e in entries
+                            if e.source == "sendfile"]
+                assert len(sendfile) >= 2, \
+                    [(e.status, e.source) for e in entries]
+                # corrupt a different chunk's extent: fallback path
+                ref = await cluster.get_file_ref("obj")
+                loc = ref.parts[0].data[2].locations[0]
+                path, off, _ln = loc.slab_extent()
+                with open(path, "r+b") as f:
+                    f.seek(off + 11)
+                    byte = f.read(1)
+                    f.seek(off + 11)
+                    f.write(bytes([byte[0] ^ 1]))
+                start = 2 * 16384 + 10
+                resp = await session.get(
+                    server.make_url("/obj"),
+                    headers={"Range": f"bytes={start}-{start + 99}"})
+                assert resp.status == 206
+                assert await resp.read() == payload[start:start + 100]
+                await asyncio.sleep(0.05)
+                entries = profiler.drain_requests()
+                assert entries and entries[-1].source in ("store",
+                                                          "cache")
+        finally:
+            await server.close()
+            await cluster.tunables.location_context().aclose()
+
+    asyncio.run(main())
+
+
+# ---- GC over slab destinations ----
+
+def test_find_unused_hashes_enumerates_index_and_marks_dead(tmp_path):
+    import subprocess
+    import sys
+
+    import yaml
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    obj = make_cluster_obj(tmp_path)
+    cluster_path = tmp_path / "cluster.yaml"
+    cluster_path.write_text(yaml.safe_dump(obj))
+    payload = os.urandom(20000)
+
+    async def setup():
+        cluster = Cluster.from_obj(obj)
+        await cluster.write_file("keep", aio.BytesReader(payload),
+                                 cluster.get_profile())
+        await cluster.write_file("drop", aio.BytesReader(payload[:7000]),
+                                 cluster.get_profile())
+        os.remove(os.path.join(str(tmp_path), "meta", "drop"))
+
+    asyncio.run(setup())
+    slab_dirs = [f"slab:{tmp_path}/disk{i}" for i in range(5)]
+    env = dict(os.environ)
+    env.setdefault("PYTHONPATH", REPO)
+    result = subprocess.run(
+        [sys.executable, "-m", "chunky_bits_tpu.cli",
+         "find-unused-hashes", "--grace-seconds", "0", "-r",
+         f"{cluster_path}#", "--", *slab_dirs],
+        capture_output=True, env=env, cwd=REPO)
+    assert result.returncode == 0, result.stderr.decode()
+    collected = [ln for ln in result.stdout.decode().splitlines()
+                 if ln.startswith("sha256-")]
+    assert len(collected) == 5  # drop's d+p chunks
+    dead = sum(slab.SlabStore(f"{tmp_path}/disk{i}").dead_bytes()
+               for i in range(5))
+    assert dead > 0
+
+    async def check():
+        cluster = Cluster.from_obj(obj)
+        ref = await cluster.get_file_ref("keep")
+        got = await cluster.file_read_builder(ref).read_all()
+        assert got == payload
+        # compaction reclaims the dead extents; keep still reads
+        for i in range(5):
+            slab.SlabStore(f"{tmp_path}/disk{i}").compact()
+        got = await cluster.file_read_builder(ref).read_all()
+        assert got == payload
+
+    asyncio.run(check())
+
+
+def test_gc_grace_window_spares_fresh_slab_chunks(tmp_path):
+    import subprocess
+    import sys
+
+    import yaml
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    obj = make_cluster_obj(tmp_path)
+    cluster_path = tmp_path / "cluster.yaml"
+    cluster_path.write_text(yaml.safe_dump(obj))
+
+    async def setup():
+        cluster = Cluster.from_obj(obj)
+        await cluster.write_file("orphan", aio.BytesReader(b"x" * 9000),
+                                 cluster.get_profile())
+        os.remove(os.path.join(str(tmp_path), "meta", "orphan"))
+
+    asyncio.run(setup())
+    env = dict(os.environ)
+    env.setdefault("PYTHONPATH", REPO)
+    result = subprocess.run(
+        [sys.executable, "-m", "chunky_bits_tpu.cli",
+         "find-unused-hashes", "--grace-seconds", "3600", "-r",
+         f"{cluster_path}#", "--",
+         *[f"slab:{tmp_path}/disk{i}" for i in range(5)]],
+        capture_output=True, env=env, cwd=REPO)
+    assert result.returncode == 0, result.stderr.decode()
+    # everything is inside the grace window: nothing collected
+    assert not [ln for ln in result.stdout.decode().splitlines()
+                if ln.startswith("sha256-")]
+    assert all(slab.SlabStore(f"{tmp_path}/disk{i}").dead_bytes() == 0
+               for i in range(5))
