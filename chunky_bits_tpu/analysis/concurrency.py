@@ -49,7 +49,10 @@ from chunky_bits_tpu.analysis.rules import Finding, Rule
 #: convention (cluster.py hands out batchers/caches loop-keyed);
 #: cluster/scrub.py rides along — the scrub daemon's task/counters are
 #: exactly the loop/thread-handoff shape this family polices
-LOOP_SCOPED_PATHS = ("gateway/", "file/", "parallel/",
+#: obs/ rides along: the metrics registry and trace buffer ARE shared
+#: process-wide by design — the rule makes each such site say so
+#: inline instead of growing silently
+LOOP_SCOPED_PATHS = ("gateway/", "file/", "parallel/", "obs/",
                      "cluster/scrub.py")
 
 #: class-body marker the CB204 pass reads: every public method of a

@@ -19,7 +19,11 @@ from typing import Iterator
 #: stay reachable through a timeout; slab.py and scrub.py joined with
 #: the packed store + scrub daemon: a long-running background walker
 #: is exactly the shape that hangs a shutdown if any wait is unbounded)
-DEVICE_NET_PATHS = ("ops/", "parallel/", "gateway/", "file/chunk_cache.py",
+#: obs/ rides along: the metrics/tracing plane is called from every
+#: serve path, so a blocking or unbounded wait there stalls the same
+#: loops the rest of this list protects
+DEVICE_NET_PATHS = ("ops/", "parallel/", "gateway/", "obs/",
+                    "file/chunk_cache.py",
                     "file/file_part.py", "file/slab.py",
                     "cluster/destination.py", "cluster/health.py",
                     "cluster/scrub.py")
@@ -400,11 +404,68 @@ class PublicAnnotationsRule(Rule):
                                             is_method=not is_static)
 
 
+class MetricLabelCardinalityRule(Rule):
+    """CB107 — metric label values must come from closed sets
+    (obs/metrics.py's cardinality rule): a label minted from a request
+    path, a client header, or any other open-ended string grows one
+    time series per distinct value — an unbounded memory leak and a
+    scrape bomb.  Flags ``.labels(...)`` arguments that are f-strings,
+    string-building expressions, call results, or request-derived
+    attribute chains; plain literals and names (bound upstream to
+    clamped/closed values) pass.  The registry's MAX_LABEL_SETS ceiling
+    is the runtime backstop; a provably-closed dynamic value records
+    its argument with ``# lint: label-cardinality-ok <reason>``.
+    """
+
+    id = "CB107"
+    slug = "label-cardinality"
+    description = ("metric label values must come from closed sets, "
+                   "never request-derived strings")
+
+    #: attribute chains that scream "request-derived"
+    TAINTED = ("request.", "req.")
+    TAINTED_ATTRS = ("path", "query_string", "rel_url", "match_info",
+                     "headers")
+
+    def _open_ended(self, node: ast.AST) -> str:
+        if isinstance(node, ast.JoinedStr):
+            return "an f-string"
+        if isinstance(node, ast.BinOp):
+            return "a string-building expression"
+        if isinstance(node, ast.Call):
+            return "a call result"
+        chain = _attr_chain(node)
+        if chain:
+            if any(chain.startswith(t) for t in self.TAINTED):
+                return f"request-derived ({chain})"
+            tail = chain.rsplit(".", 1)[-1]
+            if "." in chain and tail in self.TAINTED_ATTRS:
+                return f"request-derived ({chain})"
+        return ""
+
+    def check(self, sf) -> Iterator[Finding]:
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "labels"):
+                continue
+            values = list(node.args) + [kw.value for kw in node.keywords]
+            for val in values:
+                why = self._open_ended(val)
+                if why:
+                    yield (val.lineno, val.col_offset,
+                           f"metric label value is {why}: label values "
+                           "must come from a closed set (clamp first, "
+                           "like obs/metrics.record_request), or "
+                           "justify with `# lint: label-cardinality-ok "
+                           "<reason>`")
+
+
 #: one-line hazard descriptions for --list-rules family grouping
 FAMILY_HAZARDS = {
     "CB1xx": ("single-function invariants: bounded waits, env-flag "
               "discipline, daemon threads, narrow excepts, jit "
-              "hygiene, typing floor"),
+              "hygiene, typing floor, metric label cardinality"),
     "CB2xx": ("concurrency hazards of the two-plane host/async "
               "runtime: blocked loops, cross-plane handoffs, leaked "
               "tasks, loop-spanning shared state"),
@@ -422,4 +483,5 @@ ALL_RULES: tuple[Rule, ...] = (
     BroadExceptRule(),
     JitBodyHygieneRule(),
     PublicAnnotationsRule(),
+    MetricLabelCardinalityRule(),
 ) + CONCURRENCY_RULES

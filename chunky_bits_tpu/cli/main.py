@@ -152,6 +152,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-repair", action="store_true",
                    help="detect and report only; never resilver")
 
+    p = sub.add_parser(
+        "stats",
+        help="Fetch a running gateway's /stats, /healthz, /scrub/status"
+             " and /metrics and render a one-screen summary")
+    p.add_argument("--json", action="store_true",
+                   help="emit the combined raw JSON payloads instead")
+    p.add_argument("url", help="gateway base URL (host:port or http://…)")
+
     p = sub.add_parser("verify", help="Verify a cluster file")
     p.add_argument("target")
 
@@ -342,6 +350,10 @@ async def _run_command(args, config) -> int:
                     await asyncio.sleep(max(args.interval, 0.0))
             except (KeyboardInterrupt, asyncio.CancelledError):
                 pass
+    elif cmd == "stats":
+        from chunky_bits_tpu.cli.stats import stats_command
+
+        return await stats_command(args.url, args.json)
     elif cmd == "verify":
         target = ClusterLocation.parse(args.target)
         report = await target.verify(config)

@@ -1,0 +1,149 @@
+"""``chunky-bits stats [--json] <gateway-url>`` — one-screen gateway
+observability summary.
+
+Fetches the observability surface of a running gateway (``/stats``,
+``/healthz``, ``/scrub/status`` and — as a grammar check — ``/metrics``)
+and renders it for a human: request percentiles (computed server-side
+by the same ``request_stats``/``percentile`` code in file/profiler.py
+that bench --config 9 uses), cache hit rates, pipeline saturation,
+per-node health, scrub progress, and the event-loop lag histogram's
+tail (``obs.metrics.histogram_quantile`` over the scraped buckets).
+``--json`` emits the combined raw payloads for machine consumers.
+
+No reference counterpart (the reference has no metrics surface); a
+TPU-repo extension documented in PARITY.md.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional, TextIO
+
+from chunky_bits_tpu.errors import ChunkyBitsError
+from chunky_bits_tpu.obs import metrics as obs_metrics
+
+
+def _family(snapshot: dict, name: str) -> Optional[dict]:
+    for fam in snapshot.get("families", ()):
+        if fam.get("name") == name:
+            return fam
+    return None
+
+
+def _scalar_total(snapshot: dict, name: str) -> float:
+    fam = _family(snapshot, name)
+    if fam is None:
+        return 0.0
+    return sum(s.get("value", 0.0) for s in fam.get("samples", ()))
+
+
+def render_summary(stats: dict, healthz: dict, scrub: dict,
+                   out: TextIO) -> None:
+    """The one-screen human rendering (pure function of the fetched
+    payloads so tests can pin it without a socket)."""
+    snap = stats.get("metrics", {"families": []})
+    req = stats.get("requests", {})
+    print(f"worker {stats.get('worker', '?')} "
+          f"status={healthz.get('status', '?')} "
+          f"uptime={healthz.get('uptime_s', 0.0):.0f}s", file=out)
+    print(f"requests: n={req.get('count', 0)} "
+          f"errors={req.get('errors', 0)} "
+          f"bytes={req.get('total_bytes', 0)} "
+          f"p50={req.get('p50_ms', 0.0):.2f}ms "
+          f"p99={req.get('p99_ms', 0.0):.2f}ms "
+          f"p999={req.get('p999_ms', 0.0):.2f}ms", file=out)
+    dropped = {k: v for k, v in stats.get("dropped", {}).items() if v}
+    if dropped:
+        print(f"dropped log entries: {dropped}", file=out)
+    hits = _scalar_total(snap, "cb_cache_hits_total")
+    misses = _scalar_total(snap, "cb_cache_misses_total")
+    if hits or misses:
+        rate = 100.0 * hits / max(hits + misses, 1.0)
+        print(f"cache: hits={hits:.0f} misses={misses:.0f} "
+              f"({rate:.1f}% hit) "
+              f"bytes={_scalar_total(snap, 'cb_cache_size_bytes'):.0f}/"
+              f"{_scalar_total(snap, 'cb_cache_capacity_bytes'):.0f}",
+              file=out)
+    busy_fam = _family(snap, "cb_pipeline_busy_seconds_total")
+    if busy_fam is not None:
+        stages = ", ".join(
+            f"{s['labels'].get('stage', '?')}={s['value']:.2f}s"
+            for s in busy_fam.get("samples", ()))
+        print(f"pipeline: threads="
+              f"{_scalar_total(snap, 'cb_pipeline_threads'):.0f} "
+              f"busy[{stages}] "
+              f"idle={_scalar_total(snap, 'cb_pipeline_idle_seconds_total'):.1f}s",
+              file=out)
+    err_fam = _family(snap, "cb_node_errors_total")
+    comp_fam = _family(snap, "cb_node_completions_total")
+    if comp_fam is not None:
+        errors = {tuple(sorted(s["labels"].items())): s["value"]
+                  for s in (err_fam or {}).get("samples", ())}
+        for s in comp_fam.get("samples", ()):
+            node = s["labels"].get("node", "?")
+            err = errors.get(tuple(sorted(s["labels"].items())), 0.0)
+            print(f"node {node}: completions={s['value']:.0f} "
+                  f"errors={err:.0f}", file=out)
+    lag_fam = _family(snap, "cb_eventloop_lag_seconds")
+    if lag_fam is not None and lag_fam.get("samples"):
+        s = lag_fam["samples"][0]
+        p99 = obs_metrics.histogram_quantile(
+            lag_fam.get("buckets", []), s.get("counts", []), 99.0)
+        print(f"event loop: lag p99~{p99 * 1000.0:.2f}ms "
+              f"(n={s.get('count', 0)})", file=out)
+    if scrub.get("enabled"):
+        print(f"scrub: passes={scrub.get('passes', 0)} "
+              f"verified={scrub.get('bytes_verified', 0)}B "
+              f"corrupt={scrub.get('corrupt', 0)} "
+              f"repaired={scrub.get('repaired', 0)} "
+              f"running={scrub.get('running', False)}", file=out)
+    else:
+        print("scrub: disabled", file=out)
+
+
+async def stats_command(url: str, as_json: bool,
+                        out: Optional[TextIO] = None) -> int:
+    """Fetch + render; the ``chunky-bits stats`` body.  Raises
+    ChunkyBitsError on an unreachable/defective gateway (including a
+    /metrics payload that fails the exposition grammar — a stats tool
+    must not silently summarize garbage)."""
+    import aiohttp
+
+    out = out if out is not None else sys.stdout
+    base = url.rstrip("/")
+    if "://" not in base:
+        base = f"http://{base}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{base}/stats") as resp:
+                if resp.status != 200:
+                    raise ChunkyBitsError(
+                        f"GET /stats returned {resp.status}")
+                stats = await resp.json()
+            async with session.get(f"{base}/healthz") as resp:
+                healthz = await resp.json()
+            async with session.get(f"{base}/scrub/status") as resp:
+                scrub = await resp.json()
+            async with session.get(f"{base}/metrics") as resp:
+                metrics_text = await resp.text()
+    except aiohttp.ClientError as err:
+        raise ChunkyBitsError(f"cannot reach gateway {base}: {err}") \
+            from err
+    # the exposition grammar gate rides every stats call — the same
+    # parser the tests and CI scrape step use
+    try:
+        obs_metrics.parse_exposition(metrics_text)
+    except obs_metrics.ExpositionError as err:
+        # surfaced as the CLI's one-line error, not a traceback: a
+        # proxy answering /metrics with HTML is an operator problem to
+        # report, not a crash
+        raise ChunkyBitsError(
+            f"{base}/metrics is not valid exposition: {err}") from err
+    if as_json:
+        json.dump({"stats": stats, "healthz": healthz, "scrub": scrub},
+                  out, indent=2)
+        print(file=out)
+    else:
+        render_summary(stats, healthz, scrub, out)
+    return 0

@@ -94,6 +94,22 @@ class LocationHealth:
     completions: int
     errors: int
 
+    def to_obj(self) -> dict:
+        """Plain-dict row (the metrics registry's health collector and
+        the ``chunky-bits stats`` renderer; ``node`` is the config-
+        derived key — a closed label set per CB107)."""
+        return {
+            "node": self.key[1],
+            "kind": self.key[0],
+            "ewma_ms": (None if self.ewma_ms is None
+                        else round(self.ewma_ms, 3)),
+            "err_rate": round(self.err_rate, 4),
+            "inflight": self.inflight,
+            "breaker": self.breaker,
+            "completions": self.completions,
+            "errors": self.errors,
+        }
+
     def __str__(self) -> str:
         ewma = "-" if self.ewma_ms is None else f"{self.ewma_ms:.1f}ms"
         return (f"{self.key[1]}: ewma={ewma} "
@@ -110,6 +126,14 @@ class HealthStats:
     hedges_fired: int
     hedges_won: int
     hedges_cancelled: int
+
+    def to_obj(self) -> dict:
+        return {
+            "locations": [row.to_obj() for row in self.locations],
+            "hedges_fired": self.hedges_fired,
+            "hedges_won": self.hedges_won,
+            "hedges_cancelled": self.hedges_cancelled,
+        }
 
     def __str__(self) -> str:
         rows = "; ".join(str(r) for r in self.locations) or "no traffic"
@@ -163,6 +187,12 @@ class HealthScoreboard:
         self.hedges_fired = 0
         self.hedges_won = 0
         self.hedges_cancelled = 0
+        # weakly self-register with the process metrics registry: the
+        # scoreboard is already thread-safe, so a /metrics scrape just
+        # takes an extra stats() snapshot
+        from chunky_bits_tpu.obs.metrics import get_registry
+
+        get_registry().register_source("health", self)
 
     # ---- recording (the location.py instrument hooks call these) ----
 

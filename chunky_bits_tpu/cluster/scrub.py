@@ -174,6 +174,12 @@ class ScrubDaemon:
         self._repaired = 0
         self._repair_failures = 0
         self._last_pass_seconds: Optional[float] = None
+        # weakly self-register with the process metrics registry so a
+        # /metrics scrape reports scrub progress (the counters are
+        # already lock-guarded for exactly this cross-thread read)
+        from chunky_bits_tpu.obs.metrics import get_registry
+
+        get_registry().register_source("scrub", self)
 
     # ---- reporting ----
 

@@ -98,6 +98,15 @@ GATEWAY_SENDFILE_ENV = "CHUNKY_BITS_TPU_GATEWAY_SENDFILE"
 #: serve / `chunky-bits scrub`).
 SCRUB_BYTES_PER_SEC_ENV = "CHUNKY_BITS_TPU_SCRUB_BYTES_PER_SEC"
 
+#: slow-request tracing threshold in milliseconds (obs/tracing.py +
+#: gateway/http.py): requests at least this slow are retained — with
+#: per-plane spans — in the slowest-N buffer served at /debug/traces.
+#: 0/unset = tracing off entirely (the default — the trace ring is
+#: opt-in per the measure-before-defaulting invariant; the metrics
+#: registry itself is always on).  YAML ``trace_slow_ms`` wins; the env
+#: var supplies the default.  Read at gateway app build.
+TRACE_SLOW_MS_ENV = "CHUNKY_BITS_TPU_TRACE_SLOW_MS"
+
 #: opt-in runtime concurrency sanitizer (analysis/sanitizer.py):
 #: event-loop stall watchdog, task-leak registry, host-pipeline handoff
 #: checks.  Off by default (and force-disabled by bench.py — the
@@ -248,6 +257,18 @@ def scrub_bytes_per_sec(*, default: float = 0.0) -> float:
     return v if v > 0 else default
 
 
+def trace_slow_ms(*, default: float = 0.0) -> float:
+    """Env-supplied default for the ``trace_slow_ms`` tunable (YAML
+    wins; 0 = request tracing off).  Lenient like ``hedge_ms`` —
+    malformed or negative values read as off."""
+    raw = os.environ.get(TRACE_SLOW_MS_ENV, "")
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
 def read_retries(*, default: int = 1) -> int:
     """Env-supplied default for the ``read_retries`` tunable (YAML
     wins): per-location transient-HTTP retry count on the read
@@ -275,6 +296,12 @@ def _default_scrub_bytes_per_sec() -> float:
     """Env-supplied default for the ``scrub_bytes_per_sec`` tunable
     (YAML wins; 0 = scrub daemon off)."""
     return scrub_bytes_per_sec(default=0.0)
+
+
+def _default_trace_slow_ms() -> float:
+    """Env-supplied default for the ``trace_slow_ms`` tunable (YAML
+    wins; 0 = request tracing off)."""
+    return trace_slow_ms(default=0.0)
 
 
 def _default_host_threads() -> int:
@@ -321,6 +348,11 @@ class Tunables:
     #: ``CHUNKY_BITS_TPU_SCRUB_BYTES_PER_SEC`` supplies the default.
     scrub_bytes_per_sec: float = field(
         default_factory=_default_scrub_bytes_per_sec)
+    #: slow-request tracing threshold in ms (obs/tracing.py); 0 keeps
+    #: tracing off (the default — the trace ring is opt-in; the metrics
+    #: registry is always on).  YAML wins;
+    #: ``CHUNKY_BITS_TPU_TRACE_SLOW_MS`` supplies the default.
+    trace_slow_ms: float = field(default_factory=_default_trace_slow_ms)
 
     def is_device_backend(self) -> bool:
         """True when the erasure plane runs on an accelerator ("jax" or a
@@ -395,6 +427,16 @@ class Tunables:
             if scrub_v < 0:
                 raise SerdeError(
                     f"scrub_bytes_per_sec must be >= 0, got {scrub_v}")
+        trace_v = obj.get("trace_slow_ms", None)
+        if trace_v is not None:
+            try:
+                trace_v = float(trace_v)
+            except (TypeError, ValueError) as err:
+                raise SerdeError(
+                    f"invalid trace_slow_ms {trace_v!r}") from err
+            if trace_v < 0:
+                raise SerdeError(
+                    f"trace_slow_ms must be >= 0, got {trace_v}")
         return cls(
             https_only=bool(obj.get("https_only", False)),
             on_conflict=on_conflict,
@@ -410,6 +452,8 @@ class Tunables:
                if read_retries_v is not None else {}),
             **({"scrub_bytes_per_sec": scrub_v}
                if scrub_v is not None else {}),
+            **({"trace_slow_ms": trace_v}
+               if trace_v is not None else {}),
         )
 
     def to_obj(self) -> dict:
@@ -430,6 +474,8 @@ class Tunables:
             obj["read_retries"] = self.read_retries
         if self.scrub_bytes_per_sec > 0:
             obj["scrub_bytes_per_sec"] = self.scrub_bytes_per_sec
+        if self.trace_slow_ms > 0:
+            obj["trace_slow_ms"] = self.trace_slow_ms
         return obj
 
     def location_context(self) -> LocationContext:

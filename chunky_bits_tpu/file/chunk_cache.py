@@ -53,6 +53,18 @@ class CacheStats:
     capacity_bytes: int
     entries: int
 
+    def to_obj(self) -> dict:
+        """Plain-dict form (the metrics registry's cache collector and
+        the ``chunky-bits stats`` renderer read this)."""
+        return {
+            "hits": self.hits, "misses": self.misses,
+            "coalesced": self.coalesced, "inserts": self.inserts,
+            "evictions": self.evictions, "rejects": self.rejects,
+            "size_bytes": self.size_bytes,
+            "capacity_bytes": self.capacity_bytes,
+            "entries": self.entries,
+        }
+
     def __str__(self) -> str:
         return (f"Cache<hits={self.hits} misses={self.misses} "
                 f"coalesced={self.coalesced} evictions={self.evictions} "
@@ -97,6 +109,13 @@ class ChunkCache:
         self.inserts = 0
         self.evictions = 0
         self.rejects = 0  # corrupted pre-insert buffers refused
+        # weakly self-register with the process metrics registry so a
+        # /metrics scrape sees every live cache's counters (reads of
+        # plain ints from the scrape thread are benign; all MUTATION
+        # stays on the owning loop — the LOOP_BOUND contract holds)
+        from chunky_bits_tpu.obs.metrics import get_registry
+
+        get_registry().register_source("cache", self)
 
     def __len__(self) -> int:
         return len(self._entries)

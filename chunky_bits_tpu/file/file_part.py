@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 import enum
 import random
+import time
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Any, Iterator, Optional
 
@@ -32,6 +33,7 @@ from chunky_bits_tpu.file.chunk import Chunk
 from chunky_bits_tpu.file.hashing import AnyHash, Sha256Hash
 from chunky_bits_tpu.file.location import Location, LocationContext, \
     default_context
+from chunky_bits_tpu.obs import tracing as obs_tracing
 from chunky_bits_tpu.ops import ErasureCoder, get_coder
 from chunky_bits_tpu.utils import aio
 
@@ -491,10 +493,14 @@ class FilePart:
             failures: list[tuple[Location, str]] = []
             if health is not None:
                 health.note_primary()  # hedge-budget accrual
+            t0 = time.monotonic()
             if hedging and len(chunk.locations) > 1:
                 data = await fetch_hedged(chunk, failures)
             else:
                 data = await fetch_serial(chunk, failures)
+            obs_tracing.record_span(
+                "chunk_fetch", "network", t0, time.monotonic() - t0,
+                "ok" if data is not None else "miss")
             if failures and cx.profiler is not None:
                 for location, err in failures:
                     cx.profiler.log_location_failure(location, err)
@@ -597,8 +603,11 @@ class FilePart:
                 np.frombuffer(s, dtype=np.uint8) if s is not None else None
                 for s in slots
             ]
+            t0 = time.monotonic()
             arrays = await _reconstruct(arrays, d, p, coder, backend,
                                         batcher, data_only=True)
+            obs_tracing.record_span("reconstruct", "compute", t0,
+                                    time.monotonic() - t0)
             # rebuilt rows stay as buffers (memoryview over the array) —
             # every consumer downstream (join, hashing, socket/stdout
             # writes) takes buffer objects, so no tobytes copy

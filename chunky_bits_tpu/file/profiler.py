@@ -6,14 +6,37 @@ drains the log into a ``ProfileReport`` exposing average read/write durations
 and wall/byte totals (profiler.rs:240-329).  A thread-safe in-memory log
 replaces the reference's unbounded-channel collector task — same observable
 API, no background task to leak.
+
+Two extensions beyond the reference:
+
+* **Bounded rings.**  The reference's collector is unbounded (an
+  unread channel grows forever, profiler.rs:33-65) and so were the
+  in-memory logs here: in a long-running gateway with no reporter
+  draining them, ``_requests``/``_entries``/``_location_failures`` were
+  a slow leak.  Each is now a count-bounded drop-oldest ring
+  (``MAX_REQUESTS``/``MAX_ENTRIES``/``MAX_LOCATION_FAILURES``) with the
+  drops COUNTED — surfaced in the report (``Dropped<...>``) and the
+  metrics registry (``cb_profiler_dropped_total``) so a saturated ring
+  is an observable fact, not silent data loss.
+* **Registry feed.**  Every ``log_request``/``log_read``/``log_write``
+  also records into the process metrics registry
+  (``obs/metrics.py``: latency histograms + byte counters) and, when a
+  trace is active, a span onto the current request's trace — the
+  Profiler stays the one choke point all three telemetry surfaces
+  (stanza strings, /metrics series, /debug/traces spans) derive from,
+  so they can never disagree.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Optional
+
+from chunky_bits_tpu.obs import metrics as obs_metrics
+from chunky_bits_tpu.obs import tracing as obs_tracing
 
 
 def percentile(sorted_values: list, q: float) -> float:
@@ -64,6 +87,19 @@ class RequestStats:
     p99_ms: float
     p999_ms: float
 
+    def to_obj(self) -> dict:
+        """Plain-dict form — the gateway's ``/stats`` payload and the
+        ``chunky-bits stats`` renderer both read this, so serving
+        percentiles stay one implementation away from the source."""
+        return {
+            "count": self.count,
+            "errors": self.errors,
+            "total_bytes": self.total_bytes,
+            "p50_ms": round(self.p50_ms, 3),
+            "p99_ms": round(self.p99_ms, 3),
+            "p999_ms": round(self.p999_ms, 3),
+        }
+
     def __str__(self) -> str:
         return (f"Requests<n={self.count} errors={self.errors} "
                 f"bytes={self.total_bytes} p50={self.p50_ms:.2f}ms "
@@ -100,10 +136,23 @@ class ResultLog:
 
 class Profiler:
     """Handed to a LocationContext; log_* is called at the two I/O hooks
-    (reference: src/file/location.rs:109-112,240-242)."""
+    (reference: src/file/location.rs:109-112,240-242).  All in-memory
+    logs are drop-oldest rings (see the module docstring) — the recent
+    window a reporter actually reads survives, the unbounded tail a
+    reporterless gateway would accumulate does not."""
 
-    def __init__(self) -> None:
-        self._entries: list[ResultLog] = []
+    #: ring bounds: generous next to any reporter's drain cadence (a
+    #: bench config-9 run logs a few thousand requests), tiny next to a
+    #: week of undrained gateway traffic
+    MAX_REQUESTS = 65536
+    MAX_ENTRIES = 65536
+    MAX_LOCATION_FAILURES = 1024
+
+    def __init__(self, max_requests: Optional[int] = None,
+                 max_entries: Optional[int] = None,
+                 max_location_failures: Optional[int] = None) -> None:
+        self._entries: deque[ResultLog] = deque(
+            maxlen=max_entries or self.MAX_ENTRIES)
         self._lock = threading.Lock()
         self._caches: list = []  # read caches whose counters we surface
         self._pipelines: list = []  # host pipelines ditto
@@ -113,10 +162,31 @@ class Profiler:
         # (fetch_chunk): which location failed / was corrupt and why —
         # the diagnosable trail the anonymous `except LocationError:
         # continue` used to swallow
-        self._location_failures: list[tuple[object, str]] = []
+        self._location_failures: deque[tuple[object, str]] = deque(
+            maxlen=max_location_failures or self.MAX_LOCATION_FAILURES)
         # gateway access-log records (one per HTTP request) — the
         # serving-plane analogue of the per-I/O entries above
-        self._requests: list[RequestLog] = []
+        self._requests: deque[RequestLog] = deque(
+            maxlen=max_requests or self.MAX_REQUESTS)
+        # drop-oldest accounting per ring (also counted into the
+        # metrics registry as cb_profiler_dropped_total{kind})
+        self._dropped = {"requests": 0, "entries": 0,
+                         "location_failures": 0}
+
+    def _append(self, ring: deque, kind: str, item: object) -> bool:
+        """Ring append with drop accounting; caller holds the lock and
+        reports a True return to the registry AFTER releasing it (no
+        foreign lock is ever taken under ``self._lock``)."""
+        dropped = ring.maxlen is not None and len(ring) == ring.maxlen
+        if dropped:
+            self._dropped[kind] += 1
+        ring.append(item)
+        return dropped
+
+    def drop_counts(self) -> dict:
+        """Per-ring drop-oldest counts since construction."""
+        with self._lock:
+            return dict(self._dropped)
 
     def attach_cache(self, cache) -> None:
         """Register a chunk cache so its hit/miss/eviction/singleflight
@@ -182,11 +252,16 @@ class Profiler:
         another location or reconstruction, but a degraded cluster must
         stay diagnosable."""
         with self._lock:
-            self._location_failures.append((location, error))
+            dropped = self._append(self._location_failures,
+                                   "location_failures",
+                                   (location, error))
+        if dropped:
+            obs_metrics.record_dropped("location_failures")
 
     def drain_location_failures(self) -> list[tuple[object, str]]:
         with self._lock:
-            out, self._location_failures = self._location_failures, []
+            out = list(self._location_failures)
+            self._location_failures.clear()
         return out
 
     def log_request(self, method: str, path: str, status: int,
@@ -199,30 +274,57 @@ class Profiler:
         entry = RequestLog(method, path, status, nbytes, duration,
                            source)
         with self._lock:
-            self._requests.append(entry)
+            dropped = self._append(self._requests, "requests", entry)
+        if dropped:
+            obs_metrics.record_dropped("requests")
+        obs_metrics.record_request(method, status, nbytes, duration,
+                                   source)
 
     def drain_requests(self) -> list[RequestLog]:
         with self._lock:
-            out, self._requests = self._requests, []
+            out = list(self._requests)
+            self._requests.clear()
         return out
+
+    def peek_requests(self) -> list[RequestLog]:
+        """Non-draining snapshot of the request ring — the gateway's
+        ``/stats`` summary must not steal entries from a reporter."""
+        with self._lock:
+            return list(self._requests)
 
     def log_read(self, ok: bool, error: Optional[str], location,
                  length: int, start_time: float) -> None:
+        end = time.monotonic()
         entry = ResultLog("read", ok, error, location, length,
-                          start_time, time.monotonic())
+                          start_time, end)
         with self._lock:
-            self._entries.append(entry)
+            dropped = self._append(self._entries, "entries", entry)
+        if dropped:
+            obs_metrics.record_dropped("entries")
+        obs_metrics.record_io("read", ok, length, end - start_time)
+        # no io.read span: the read path's network time is already
+        # attributed by the enclosing chunk_fetch span
+        # (file/file_part.py) — a second span here would double-count
+        # plane_ms["network"] in /debug/traces
 
     def log_write(self, ok: bool, error: Optional[str], location,
                   length: int, start_time: float) -> None:
+        end = time.monotonic()
         entry = ResultLog("write", ok, error, location, length,
-                          start_time, time.monotonic())
+                          start_time, end)
         with self._lock:
-            self._entries.append(entry)
+            dropped = self._append(self._entries, "entries", entry)
+        if dropped:
+            obs_metrics.record_dropped("entries")
+        obs_metrics.record_io("write", ok, length, end - start_time)
+        obs_tracing.record_span("io.write", "network", start_time,
+                                end - start_time,
+                                "ok" if ok else "error")
 
     def drain(self) -> list[ResultLog]:
         with self._lock:
-            out, self._entries = self._entries, []
+            out = list(self._entries)
+            self._entries.clear()
         return out
 
 
@@ -230,7 +332,8 @@ class ProfileReport:
     def __init__(self, entries: list[ResultLog], cache_stats: list = (),
                  pipeline_stats: list = (), health_stats: list = (),
                  location_failures: list = (), requests: list = (),
-                 scrub_stats: list = ()):
+                 scrub_stats: list = (),
+                 dropped: Optional[dict] = None):
         self.entries = entries
         self.cache_stats = list(cache_stats)
         self.pipeline_stats = list(pipeline_stats)
@@ -238,6 +341,7 @@ class ProfileReport:
         self.location_failures = list(location_failures)
         self.requests = list(requests)
         self.scrub_stats = list(scrub_stats)
+        self.dropped = dict(dropped or {})
 
     def _avg(self, kind: str) -> Optional[float]:
         durations = [e.duration for e in self.entries if e.kind == kind]
@@ -285,6 +389,10 @@ class ProfileReport:
             if extra > 0:
                 shown += f"; +{extra} more"
             base += f" ReadFailures<{shown}>"
+        drops = {k: v for k, v in self.dropped.items() if v}
+        if drops:
+            inner = " ".join(f"{k}={v}" for k, v in sorted(drops.items()))
+            base += f" Dropped<{inner}>"
         return base
 
 
@@ -301,7 +409,8 @@ class ProfileReporter:
                              self._profiler.health_stats(),
                              self._profiler.drain_location_failures(),
                              self._profiler.drain_requests(),
-                             self._profiler.scrub_stats())
+                             self._profiler.scrub_stats(),
+                             self._profiler.drop_counts())
 
 
 def new_profiler() -> tuple[Profiler, ProfileReporter]:

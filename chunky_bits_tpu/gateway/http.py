@@ -62,7 +62,9 @@ from aiohttp import web
 from chunky_bits_tpu.cluster import Cluster
 from chunky_bits_tpu.errors import ChunkyBitsError, MetadataReadError
 from chunky_bits_tpu.file.file_reference import FileReference
-from chunky_bits_tpu.file.profiler import Profiler
+from chunky_bits_tpu.file.profiler import Profiler, request_stats
+from chunky_bits_tpu.obs import metrics as obs_metrics
+from chunky_bits_tpu.obs import tracing as obs_tracing
 from chunky_bits_tpu.utils import aio
 
 log = logging.getLogger("chunky_bits_tpu.gateway")
@@ -99,6 +101,26 @@ _VERIFIED_MEMO_ENTRIES = 4096
 #: the app's request-log profiler (``make_app`` stores it here; tests
 #: and bench read percentiles off it)
 PROFILER_KEY: web.AppKey = web.AppKey("cb_profiler", Profiler)
+
+#: the app's liveness/readiness state (``GET /healthz`` reads it; the
+#: worker child flips ``draining`` on SIGTERM)
+HEALTH_KEY: web.AppKey = web.AppKey("cb_health", object)
+
+#: seconds between per-worker snapshot publications into the fleet
+#: metrics spool (gateway/workers.py) — the staleness bound on OTHER
+#: workers' series in an aggregated /metrics scrape (the scraped
+#: worker's own series are always live)
+_SPOOL_INTERVAL = 2.0
+
+
+class HealthState:
+    """Per-worker liveness/readiness: ``draining`` flips once shutdown
+    has been requested, so a load balancer polling ``/healthz`` stops
+    routing to this worker before its listener actually closes."""
+
+    def __init__(self) -> None:
+        self.started = time.monotonic()
+        self.draining = False
 
 
 class HttpRangeError(ValueError):
@@ -283,11 +305,39 @@ def make_app(cluster: Cluster,
              max_concurrent_gets: int = DEFAULT_MAX_CONCURRENT_GETS,
              sendfile: Optional[bool] = None,
              profiler: Optional[Profiler] = None,
-             scrub=None) -> web.Application:
+             scrub=None,
+             metrics_spool: Optional[str] = None,
+             health_state: Optional[HealthState] = None
+             ) -> web.Application:
     # <=0 means unbounded, like the reference's ingest (and matching
     # min_put_rate's "0 disables" convention)
     put_sem = (asyncio.Semaphore(max_concurrent_puts)
                if max_concurrent_puts > 0 else contextlib.nullcontext())
+
+    # the process metrics registry: the durable sink every stat source
+    # in this app feeds (obs/metrics.py) — /metrics and /stats read it;
+    # the fleet spool (multi-worker serve) aggregates it across workers
+    registry = obs_metrics.get_registry()
+    worker_id = str(os.getpid())
+    # the fleet-aggregation probe: one gauge that is 1 for every live
+    # worker, so a merged scrape shows exactly which workers reported
+    registry.gauge("cb_worker_up",
+                   "this worker process is serving").set(1)
+    shed_counter = registry.counter(
+        "cb_gateway_gets_shed_total",
+        "GETs shed with 503 by read admission control")
+    put_reject_counter = registry.counter(
+        "cb_gateway_puts_rejected_total", "PUT ingests rejected",
+        labels=("reason",))
+    inflight_gauge = registry.gauge(
+        "cb_gateway_gets_in_flight", "GET bodies currently streaming")
+
+    if health_state is None:
+        health_state = HealthState()
+
+    # slow-request tracing threshold (obs/tracing.py), read at app
+    # build like every other knob; 0 = tracing off (the default)
+    trace_slow_s = max(cluster.tunables.trace_slow_ms, 0.0) / 1000.0
 
     # sendfile defaults from the tunable, read here at app build (the
     # gateway's first-use moment, like every other knob)
@@ -556,16 +606,19 @@ def make_app(cluster: Cluster,
         # what to do.
         if (max_concurrent_gets > 0
                 and gets_in_flight["now"] >= max_concurrent_gets):
+            shed_counter.inc()
             return web.Response(
                 status=503, text="error: too many in-flight reads\n",
                 headers={"Retry-After": _RETRY_AFTER_SECONDS})
         gets_in_flight["now"] += 1
+        inflight_gauge.set(gets_in_flight["now"])
         try:
             return await _serve_get_body(request, path, file_ref,
                                          builder, status, headers,
                                          length)
         finally:
             gets_in_flight["now"] -= 1
+            inflight_gauge.set(gets_in_flight["now"])
 
     async def _serve_get_body(request: web.Request, path: str,
                               file_ref: FileReference, builder,
@@ -642,6 +695,7 @@ def make_app(cluster: Cluster,
         if max_put_bytes is not None:
             declared = request.headers.get("Content-Length")
             if declared is not None and int(declared) > max_put_bytes:
+                put_reject_counter.labels(reason="too_large").inc()
                 return put_reject(413, "error: body too large\n")
 
         # A rejected/aborted ingest can leave orphaned shards; they are
@@ -656,13 +710,16 @@ def make_app(cluster: Cluster,
                                  min_put_rate),
                     profile, content_type)
             except _BodyTooLarge:
+                put_reject_counter.labels(reason="too_large").inc()
                 return put_reject(413, "error: body too large\n")
             except _BodyTooSlow:
+                put_reject_counter.labels(reason="too_slow").inc()
                 return put_reject(408, "error: ingest too slow\n")
             except ChunkyBitsError as err:
                 log.error("PUT %s failed: %s", path, err)
                 log.error("location health at failure: %s",
                           health.stats())
+                put_reject_counter.labels(reason="error").inc()
                 return put_reject(500, "error: internal error\n")
         return web.Response(status=200)
 
@@ -671,12 +728,25 @@ def make_app(cluster: Cluster,
                          ) -> web.StreamResponse:
         """One structured record per request — the log line operators
         grep and the counters bench --config 9 reports are the same
-        numbers (Profiler.log_request -> request_stats).  ``bytes`` is
-        the declared body length: an aborted stream still logs the
-        length it promised (the abort itself is logged separately)."""
+        numbers (Profiler.log_request -> request_stats; log_request
+        also feeds the metrics registry, so /metrics percentiles are
+        the same numbers again).  ``bytes`` is the declared body
+        length: an aborted stream still logs the length it promised
+        (the abort itself is logged separately).
+
+        When ``tunables.trace_slow_ms`` arms tracing, this middleware
+        is also the trace root: it mints (or accepts via
+        ``X-Chunky-Trace``) the request's trace id and parks the trace
+        in the context — every task the handler spawns inherits it,
+        and pipeline jobs carry it across the thread boundary."""
         start = time.monotonic()
         status = 500
         nbytes = 0
+        trace = token = None
+        if trace_slow_s > 0:
+            trace_id = obs_tracing.clean_id(
+                request.headers.get("X-Chunky-Trace"))
+            trace, token = obs_tracing.start(trace_id)
         try:
             resp = await handler(request)
             status = resp.status
@@ -694,6 +764,15 @@ def make_app(cluster: Cluster,
             source = request.get("cb_source", "-")
             profiler.log_request(request.method, request.path, status,
                                  nbytes, duration, source)
+            if trace is not None and token is not None:
+                trace.add("request", "gateway", start, duration,
+                          str(status))
+                obs_tracing.finish(
+                    trace, token, duration=duration,
+                    slow_s=trace_slow_s,
+                    meta={"method": request.method,
+                          "path": request.path, "status": status,
+                          "source": source, "worker": worker_id})
             log.info(
                 "req method=%s path=%s status=%d bytes=%d ms=%.2f "
                 "source=%s", request.method, request.path, status,
@@ -711,12 +790,113 @@ def make_app(cluster: Cluster,
             payload = {"enabled": True, **scrub.stats().to_obj()}
         return web.json_response(payload)
 
+    async def handle_metrics(request: web.Request) -> web.Response:
+        """Prometheus text exposition.  Single-process: this worker's
+        registry.  Under a multi-worker supervisor (``metrics_spool``
+        set): the FLEET view — this worker's live snapshot merged with
+        every sibling's spooled one (counters/histograms summed, gauges
+        labeled by worker) — so one scrape covers the whole
+        SO_REUSEPORT fleet no matter which worker the kernel picked."""
+        request["cb_source"] = "meta"
+        own = registry.snapshot()
+        if metrics_spool is not None:
+            merged = await asyncio.to_thread(
+                obs_metrics.fleet_snapshot, metrics_spool,
+                (worker_id, own))
+        else:
+            merged = obs_metrics.merge_snapshots([(None, own)])
+        return web.Response(
+            text=obs_metrics.render_exposition(merged),
+            content_type="text/plain", charset="utf-8")
+
+    async def handle_stats(request: web.Request) -> web.Response:
+        """JSON snapshot twin of /metrics (this worker only — machine
+        consumers wanting the fleet read /metrics), plus the access-log
+        summary computed by the same ``request_stats``/``percentile``
+        code bench --config 9 uses."""
+        request["cb_source"] = "meta"
+        return web.json_response({
+            "worker": worker_id,
+            "requests": request_stats(
+                profiler.peek_requests()).to_obj(),
+            "dropped": profiler.drop_counts(),
+            "metrics": registry.snapshot(),
+        })
+
+    async def handle_healthz(request: web.Request) -> web.Response:
+        """Per-worker liveness/readiness: 200 while serving, 503 once
+        draining (shutdown requested, listener still up) — the signal a
+        balancer needs to stop routing here before connections break."""
+        request["cb_source"] = "meta"
+        if health_state.draining:
+            return web.json_response(
+                {"status": "draining", "worker": worker_id},
+                status=503)
+        return web.json_response({
+            "status": "ok", "worker": worker_id,
+            "uptime_s": round(time.monotonic() - health_state.started,
+                              3)})
+
+    async def handle_debug_traces(request: web.Request) -> web.Response:
+        """The slowest-N retained traces (per worker — a trace is one
+        worker's story), slowest first, with per-plane time so "which
+        plane ate the p999" reads straight off the payload."""
+        request["cb_source"] = "meta"
+        return web.json_response({
+            "enabled": trace_slow_s > 0,
+            "trace_slow_ms": trace_slow_s * 1000.0,
+            "worker": worker_id,
+            "traces": obs_tracing.buffer().snapshot(),
+        })
+
+    # always-on event-loop lag sampler + (multi-worker) the periodic
+    # snapshot publication the fleet /metrics merge reads; both bound
+    # to the app's lifecycle so tests and restarts leak nothing
+    lag_monitor = obs_metrics.LoopLagMonitor(registry)
+    spool_task: dict = {"task": None}
+
+    async def _spool_writer() -> None:
+        path = os.path.join(metrics_spool, f"worker-{worker_id}.json")
+        while True:
+            snap = registry.snapshot()
+            try:
+                await asyncio.to_thread(
+                    obs_metrics.write_snapshot_file, path, snap)
+            except OSError as err:
+                # a failed heartbeat (ENOSPC, spool dir racing the
+                # supervisor's teardown) must not kill the writer: the
+                # next beat retries, and the loss is logged so a worker
+                # going stale in the fleet view is diagnosable
+                log.warning("metrics spool write failed: %s", err)
+            await asyncio.sleep(_SPOOL_INTERVAL)
+
+    async def _on_startup(app: web.Application) -> None:
+        lag_monitor.start(asyncio.get_running_loop())
+        if metrics_spool is not None:
+            spool_task["task"] = asyncio.ensure_future(_spool_writer())
+
+    async def _on_cleanup(app: web.Application) -> None:
+        lag_monitor.stop()
+        task = spool_task["task"]
+        if task is not None:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+            spool_task["task"] = None
+
     app = web.Application(middlewares=[access_log])
     app[PROFILER_KEY] = profiler
-    # registered before the catch-all: the status endpoint shadows an
-    # object literally named "scrub/status" (documented deviation — the
-    # reference's gateway has no non-object routes at all)
+    app[HEALTH_KEY] = health_state
+    app.on_startup.append(_on_startup)
+    app.on_cleanup.append(_on_cleanup)
+    # registered before the catch-all: these endpoints shadow objects
+    # literally named "scrub/status", "metrics", "stats", "healthz",
+    # "debug/traces" (documented deviation — the reference's gateway
+    # has no non-object routes at all)
     app.router.add_get("/scrub/status", handle_scrub_status)
+    app.router.add_get("/metrics", handle_metrics)
+    app.router.add_get("/stats", handle_stats)
+    app.router.add_get("/healthz", handle_healthz)
+    app.router.add_get("/debug/traces", handle_debug_traces)
     app.router.add_get("/{path:.*}", handle_get)  # also serves HEAD
     app.router.add_put("/{path:.*}", handle_put)
     return app
@@ -730,7 +910,9 @@ async def serve(cluster: Cluster, host: str = "127.0.0.1",
                 max_concurrent_gets: int = DEFAULT_MAX_CONCURRENT_GETS,
                 workers: Optional[int] = None,
                 reuse_port: bool = False,
-                on_ready: Optional[Callable[[int], None]] = None
+                on_ready: Optional[Callable[[int], None]] = None,
+                metrics_spool: Optional[str] = None,
+                health_state: Optional[HealthState] = None
                 ) -> None:
     """Bind and serve until cancelled (ctrl-c graceful shutdown,
     main.rs:474-485).
@@ -782,7 +964,8 @@ async def serve(cluster: Cluster, host: str = "127.0.0.1",
                  max_concurrent_puts=max_concurrent_puts,
                  min_put_rate=min_put_rate,
                  max_concurrent_gets=max_concurrent_gets,
-                 scrub=scrub))
+                 scrub=scrub, metrics_spool=metrics_spool,
+                 health_state=health_state))
     await runner.setup()
     site = web.TCPSite(runner, host, port, reuse_port=reuse_port)
     await site.start()

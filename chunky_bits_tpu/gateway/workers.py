@@ -50,6 +50,7 @@ import asyncio
 import json
 import logging
 import os
+import shutil
 import signal
 import socket
 import sys
@@ -58,6 +59,7 @@ import time
 from typing import Callable, Optional
 
 from chunky_bits_tpu.errors import ChunkyBitsError
+from chunky_bits_tpu.obs import metrics as obs_metrics
 
 log = logging.getLogger("chunky_bits_tpu.gateway.workers")
 
@@ -71,6 +73,12 @@ _BACKOFF_INITIAL = 0.5
 _BACKOFF_CAP = 10.0
 #: a worker that survived this long resets its slot's backoff
 _BACKOFF_RESET_UPTIME = 30.0
+
+#: seconds a SIGTERM'd worker keeps its listener up while /healthz
+#: answers 503 draining (in-flight requests finish; balancers observe
+#: the drain) before serve is cancelled — well under the supervisor's
+#: 5 s SIGKILL escalation
+_DRAIN_SECONDS = 0.5
 
 
 def _reuse_port_supported() -> bool:
@@ -99,6 +107,11 @@ class GatewaySupervisor:
         self.ready_timeout = ready_timeout
         self._placeholder: Optional[socket.socket] = None
         self._spec_path: Optional[str] = None
+        #: fleet metrics spool: every worker publishes its registry
+        #: snapshot here (obs/metrics.py) so ANY worker's /metrics can
+        #: serve the aggregated fleet view; created at start, removed
+        #: at stop
+        self.metrics_spool: Optional[str] = None
         self._procs: list = [None] * workers
         self._ready: list = [False] * workers
         self._slot_tasks: list = []
@@ -123,6 +136,10 @@ class GatewaySupervisor:
                 f"cannot bind {self.host}:{self.port}: {err}") from err
         self._placeholder = sock
         self.port = sock.getsockname()[1]
+        self.metrics_spool = await asyncio.to_thread(
+            tempfile.mkdtemp, prefix="cb-gateway-metrics-")
+        self.serve_params.setdefault("metrics_spool",
+                                     self.metrics_spool)
         self._spec_path = await asyncio.to_thread(self._write_spec)
         self._slot_tasks = [
             asyncio.ensure_future(self._run_slot(i))
@@ -152,6 +169,17 @@ class GatewaySupervisor:
         the respawn test keys off exactly that)."""
         return [p.pid for p in self._procs
                 if p is not None and p.returncode is None]
+
+    def fleet_snapshot(self) -> dict:
+        """The aggregated fleet metrics snapshot straight off the
+        spool (counters/histograms summed, gauges worker-labeled) —
+        the supervisor-side twin of any worker's ``GET /metrics``, for
+        tooling that has the supervisor but not a socket.  Blocking
+        file reads (small JSON files); call off-loop from async code.
+        Empty until the first worker heartbeat (~2 s after ready)."""
+        if self.metrics_spool is None:
+            return {"families": []}
+        return obs_metrics.fleet_snapshot(self.metrics_spool)
 
     async def wait(self) -> None:
         """Run until cancelled (the serve loop's park)."""
@@ -201,6 +229,10 @@ class GatewaySupervisor:
             path = self._spec_path
             self._spec_path = None
             await asyncio.to_thread(self._unlink_quiet, path)
+        if self.metrics_spool is not None:
+            spool = self.metrics_spool
+            self.metrics_spool = None
+            await asyncio.to_thread(shutil.rmtree, spool, True)
 
     # ---- internals ----
 
@@ -274,6 +306,17 @@ class GatewaySupervisor:
                     pass
             rc = await self._wait_exit(proc)
             self._drain_tasks.pop(proc.pid, None)
+            # reap the dead worker's spool snapshot: the fleet /metrics
+            # view must report who is ALIVE — a crashed worker's frozen
+            # gauges (in-flight counts, worker_up) must not haunt every
+            # scrape until supervisor stop.  Its counters drop out of
+            # the fleet totals, which Prometheus-style consumers treat
+            # as an ordinary counter reset.
+            if self.metrics_spool is not None:
+                await asyncio.to_thread(
+                    self._unlink_quiet,
+                    os.path.join(self.metrics_spool,
+                                 f"worker-{proc.pid}.json"))
             if self._stopping:
                 return
             uptime = time.monotonic() - spawned_at
@@ -357,14 +400,22 @@ async def serve_workers(cluster, host: str, port: int, workers: int,
 
 async def _worker_amain(spec: dict) -> None:
     from chunky_bits_tpu.cluster import Cluster
-    from chunky_bits_tpu.gateway.http import serve
+    from chunky_bits_tpu.gateway.http import HealthState, serve
 
     cluster = Cluster.from_obj(spec["cluster"])
     loop = asyncio.get_running_loop()
     stop = asyncio.Event()
+    health_state = HealthState()
+
+    def request_stop() -> None:
+        # flip /healthz to draining FIRST: a balancer polling it stops
+        # routing before the listener actually goes away
+        health_state.draining = True
+        stop.set()
+
     for sig in (signal.SIGTERM, signal.SIGINT):
         try:
-            loop.add_signal_handler(sig, stop.set)
+            loop.add_signal_handler(sig, request_stop)
         except (NotImplementedError, RuntimeError):
             pass  # non-unix / nested-loop harnesses: supervisor kills
 
@@ -374,7 +425,8 @@ async def _worker_amain(spec: dict) -> None:
 
     serve_task = asyncio.ensure_future(serve(
         cluster, host=spec["host"], port=spec["port"], workers=1,
-        reuse_port=True, on_ready=announce, **spec.get("serve", {})))
+        reuse_port=True, on_ready=announce,
+        health_state=health_state, **spec.get("serve", {})))
     stop_task = asyncio.ensure_future(stop.wait())
     try:
         # lint: unbounded-await-ok the worker's lifetime IS the service
@@ -382,6 +434,13 @@ async def _worker_amain(spec: dict) -> None:
         # crash (serve_task), and the supervisor escalates to SIGKILL
         await asyncio.wait({serve_task, stop_task},
                            return_when=asyncio.FIRST_COMPLETED)
+        if stop.is_set() and not serve_task.done():
+            # drain window: /healthz already answers 503 draining —
+            # give in-flight requests (and one balancer poll) a beat
+            # before the listener is torn down
+            # lint: unbounded-await-ok bounded by timeout=_DRAIN_SECONDS
+            # (0.5 s), well under the supervisor's SIGKILL escalation
+            await asyncio.wait({serve_task}, timeout=_DRAIN_SECONDS)
     finally:
         serve_task.cancel()
         stop_task.cancel()

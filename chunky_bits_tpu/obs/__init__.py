@@ -1,0 +1,20 @@
+"""Observability plane: unified metrics registry + request tracing.
+
+A TPU-repo extension (the reference has no metrics surface at all —
+src/file/profiler.rs renders one-shot report strings and that is the
+whole story): ``obs.metrics`` is the process-wide, thread-safe sink
+behind every existing stat source (chunk cache, host pipeline, health
+scoreboard, scrub daemon, the gateway access log), exposed as
+Prometheus text at gateway ``GET /metrics`` and JSON at ``GET /stats``;
+``obs.tracing`` follows one request across the async plane, the host
+pipeline's worker threads, and the network fetches, into a bounded
+slowest-N buffer served at ``GET /debug/traces``.
+
+Both modules are stdlib-only and import nothing from the rest of the
+package, so every layer (file/, parallel/, cluster/, gateway/) may feed
+them without import cycles, and the linter (which must run with the
+tunnel down and no third-party deps) can scan them like any other
+module.
+"""
+
+from chunky_bits_tpu.obs import metrics, tracing  # noqa: F401

@@ -61,6 +61,7 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from chunky_bits_tpu.errors import ErasureError
+from chunky_bits_tpu.obs import tracing as obs_tracing
 
 #: worker queue poll: the bound on every blocking get/put — short enough
 #: that shutdown is prompt, long enough to stay off the scheduler's hot
@@ -85,7 +86,8 @@ class _Job:
     future (async)."""
 
     __slots__ = ("stage", "fn", "nbytes", "result", "error",
-                 "_event", "_callbacks", "_lock", "_started")
+                 "_event", "_callbacks", "_lock", "_started",
+                 "trace", "submitted_at")
 
     def __init__(self, stage: str, fn: Callable[[], Any],
                  nbytes: int = 0) -> None:
@@ -98,6 +100,14 @@ class _Job:
         self._callbacks: list[Callable[["_Job"], None]] = []
         self._lock = threading.Lock()
         self._started = False
+        # capture-at-submit: the contextvar trace of the SUBMITTING
+        # thread (None when tracing is off or the submitter is itself a
+        # worker) rides the job across the plane boundary so queue-wait
+        # and execution spans land on the request that asked (obs/
+        # tracing.py — one ContextVar.get when tracing is off)
+        self.trace = obs_tracing.current()
+        self.submitted_at = time.monotonic() if self.trace is not None \
+            else 0.0
 
     def _claim(self) -> bool:
         """Atomically claim the right to run this job.  Shutdown races
@@ -177,6 +187,10 @@ class PipelineStageStats:
     busy_s: float
     nbytes: int
 
+    def to_obj(self) -> dict:
+        return {"stage": self.stage, "jobs": self.jobs,
+                "busy_s": round(self.busy_s, 6), "nbytes": self.nbytes}
+
     def __str__(self) -> str:
         return f"{self.stage}: {self.jobs}j/{self.busy_s:.3f}s/{self.nbytes}B"
 
@@ -190,6 +204,11 @@ class PipelineStats:
     threads: int
     idle_s: float
     stages: tuple[PipelineStageStats, ...]
+
+    def to_obj(self) -> dict:
+        return {"threads": self.threads,
+                "idle_s": round(self.idle_s, 6),
+                "stages": [s.to_obj() for s in self.stages]}
 
     def __str__(self) -> str:
         inner = " | ".join(str(s) for s in self.stages)
@@ -244,6 +263,12 @@ class HostPipeline:
             from chunky_bits_tpu.analysis.sanitizer import get_monitor
 
             get_monitor()
+        # weakly self-register with the process metrics registry so a
+        # /metrics scrape folds in per-stage busy/idle/bytes counters
+        # (stats() is already lock-guarded and thread-safe)
+        from chunky_bits_tpu.obs.metrics import get_registry
+
+        get_registry().register_source("pipeline", self)
         self._workers = [
             threading.Thread(target=self._worker, daemon=True,
                              name=f"{name}-{i}")
@@ -271,6 +296,7 @@ class HostPipeline:
         if not job._claim():
             return  # a racing claimant (shutdown rescue) already ran it
         t0 = time.perf_counter()
+        t0_mono = time.monotonic() if job.trace is not None else 0.0
         try:
             job.result = job.fn()
         # lint: broad-except-ok delivered verbatim to the waiter via
@@ -284,6 +310,15 @@ class HostPipeline:
                 st[0] += 1
                 st[1] += dt
                 st[2] += job.nbytes
+            if job.trace is not None:
+                # two spans per traced job: how long it WAITED (the
+                # queue — saturation's signature) and how long it RAN
+                job.trace.add(f"queue.{job.stage}", "host",
+                              job.submitted_at,
+                              max(t0_mono - job.submitted_at, 0.0))
+                job.trace.add(f"pipeline.{job.stage}", "host", t0_mono,
+                              dt, "ok" if job.error is None
+                              else "error")
             job._finish()
 
     def _offer(self, job: _Job) -> None:

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""CI metrics-scrape smoke: boot a throwaway gateway on a temp
+cluster, drive one PUT/GET, scrape /metrics + /healthz + /stats, and
+validate the exposition against the strict line grammar
+(chunky_bits_tpu.obs.metrics.parse_exposition — the same parser the
+tests and `chunky-bits stats` use).  Exit 0 with "metrics smoke OK" on
+success; any grammar violation or missing family fails the step.
+
+Run: python scripts/metrics_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import tempfile
+
+# runnable as `python scripts/metrics_smoke.py` from the repo root (the
+# CI invocation): script mode puts scripts/ on sys.path, not the root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: families a fresh single-worker gateway must expose after one
+#: PUT + one GET (cache families need cache_bytes on; node/pipeline
+#: families need actual I/O — the roundtrip provides both)
+REQUIRED_FAMILIES = (
+    "cb_request_seconds",
+    "cb_request_total",
+    "cb_request_bytes_total",
+    "cb_worker_up",
+    "cb_cache_hits_total",
+    "cb_pipeline_jobs_total",
+    "cb_node_completions_total",
+    "cb_eventloop_lag_seconds",
+    "cb_gateway_gets_in_flight",
+)
+
+
+async def main() -> int:
+    import aiohttp
+    from aiohttp.test_utils import TestServer
+
+    from chunky_bits_tpu.cluster import Cluster
+    from chunky_bits_tpu.gateway import make_app
+    from chunky_bits_tpu.obs.metrics import parse_exposition
+
+    with tempfile.TemporaryDirectory() as tmp:
+        dirs = []
+        for i in range(5):
+            d = os.path.join(tmp, f"disk{i}")
+            os.makedirs(d)
+            dirs.append(d)
+        meta = os.path.join(tmp, "meta")
+        os.makedirs(meta)
+        cluster = Cluster.from_obj({
+            "destinations": [{"location": d} for d in dirs],
+            "metadata": {"type": "path", "format": "yaml",
+                         "path": meta},
+            "profiles": {"default": {"data": 3, "parity": 2,
+                                     "chunk_size": 16}},
+            "tunables": {"cache_bytes": 4 << 20},
+        })
+        server = TestServer(make_app(cluster))
+        await server.start_server()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            async with aiohttp.ClientSession() as session:
+                payload = os.urandom(200000)
+                resp = await session.put(f"{url}/obj", data=payload)
+                assert resp.status == 200, resp.status
+                resp = await session.get(f"{url}/obj")
+                assert await resp.read() == payload
+                resp = await session.get(f"{url}/healthz")
+                assert resp.status == 200, resp.status
+                resp = await session.get(f"{url}/stats")
+                stats = await resp.json()
+                assert stats["requests"]["count"] >= 2, stats
+                resp = await session.get(f"{url}/metrics")
+                assert resp.status == 200, resp.status
+                parsed = parse_exposition(await resp.text())
+        finally:
+            await server.close()
+        await cluster.tunables.location_context().aclose()
+    missing = [f for f in REQUIRED_FAMILIES if f not in parsed]
+    if missing:
+        print(f"metrics smoke FAILED: missing families {missing}",
+              file=sys.stderr)
+        return 1
+    print(f"metrics smoke OK ({len(parsed)} families, "
+          "exposition grammar valid)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))
