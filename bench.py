@@ -1817,6 +1817,233 @@ def bench_slab_store(argv=()) -> None:
         sys.exit(3)
 
 
+def bench_repair_bandwidth(argv=()) -> None:
+    """BASELINE.md config 11: repair-bandwidth A/B (CPU-only, no
+    device, no watchdog).  Many small objects are written with
+    per-chunk block-digest trees (``repair_block_bytes``) onto MIXED
+    ``slab:`` and plain-path destinations, a localized single-block bit
+    flip is injected into one chunk replica of a corrupt subset, and a
+    scrub/repair pass runs once per leg: OFF = the legacy shape
+    (``ScrubDaemon(planner=False)``: part-granular resilver re-reads
+    every replica of a damaged part), ON = the targeted repair planner
+    (cluster/repair.py: block-localized ranged reads off the
+    healthiest d helpers, batched rebuild, in-place rewrite).  Reported
+    per leg: repair bytes read per rebuilt chunk byte (the headline —
+    the planner's structural win), scrub wall time, and the per-node
+    I/O-completion distribution (health-scoreboard completions over the
+    pass: verification + helper reads AND repair writes — the node
+    balance view, not a pure read count); repaired objects are
+    asserted byte-identical to their payloads in-run.  Repair reads are measured from the metrics
+    registry's ``cb_io_bytes_total{op=read}`` deltas (a profiler rides
+    the pass, so every location read is recorded) minus the scrub
+    stats' verification bytes — actual I/O, not estimates.
+
+    Flags: ``--objects N`` (default 200), ``--corrupt N`` damaged
+    objects (default 40), ``--chunk-log2 N`` (default 16 = 64 KiB),
+    ``--block-kib N`` digest-tree block (default 4), ``--smoke``
+    (CI-scale: 30 objects, 8 corrupt).
+
+    Failure contract (tests/test_bench_outage.py): ANY failure still
+    emits exactly one parseable JSON line and exits 3."""
+    import asyncio
+    import contextlib
+    import os
+    import random as _random
+    import tempfile
+
+    argv = list(argv)
+
+    def flag(name, default, cast):
+        if name in argv:
+            return cast(argv[argv.index(name) + 1])
+        return default
+
+    metric = "repair_bytes_reduction_d3p2_localized"
+    try:
+        objects = flag("--objects", 200, int)
+        corrupt = flag("--corrupt", 40, int)
+        chunk_log2 = flag("--chunk-log2", 16, int)
+        block_kib = flag("--block-kib", 4, int)
+        if "--smoke" in argv:
+            objects = min(objects, 30)
+            corrupt = min(corrupt, 8)
+        if objects <= 0 or corrupt <= 0 or corrupt > objects:
+            raise ValueError(
+                "--objects and --corrupt must be positive, "
+                "corrupt <= objects")
+        if not (12 <= chunk_log2 <= 22):
+            raise ValueError("--chunk-log2 out of range [12, 22]")
+        if block_kib <= 0 or (block_kib << 10) >= (1 << chunk_log2):
+            raise ValueError(
+                "--block-kib must be positive and smaller than a chunk")
+
+        from chunky_bits_tpu.cluster import Cluster
+        from chunky_bits_tpu.cluster.scrub import ScrubDaemon
+        from chunky_bits_tpu.file.profiler import new_profiler
+        from chunky_bits_tpu.obs.metrics import get_registry
+        from chunky_bits_tpu.utils import aio
+
+        d, p = 3, 2
+        chunk_bytes = 1 << chunk_log2
+        block_bytes = block_kib << 10
+        rng = np.random.default_rng(0)
+        payloads = [rng.integers(0, 256, d * chunk_bytes,
+                                 dtype=np.uint8).tobytes()
+                    for _ in range(objects)]
+        picks = _random.Random(7)
+        # (object index, damaged chunk slot, byte offset) per victim —
+        # identical corruption for both legs
+        damage = [(i, picks.randrange(d),
+                   picks.randrange(chunk_bytes))
+                  for i in picks.sample(range(objects), corrupt)]
+
+        def make_cluster(root: str) -> Cluster:
+            dirs = []
+            for i in range(5):
+                disk = os.path.join(root, f"disk{i}")
+                os.makedirs(disk, exist_ok=True)
+                # the mixed-operations shape: packed slab stores AND
+                # file-per-chunk path destinations in one cluster
+                dirs.append(f"slab:{disk}" if i < 3 else disk)
+            meta = os.path.join(root, "meta")
+            os.makedirs(meta, exist_ok=True)
+            return Cluster.from_obj({
+                "destinations": [{"location": x} for x in dirs],
+                "metadata": {"type": "path", "format": "yaml",
+                             "path": meta},
+                "profiles": {"default": {
+                    "data": d, "parity": p,
+                    "chunk_size": chunk_log2}},
+                "tunables": {"repair_block_bytes": block_bytes},
+            })
+
+        def flip_byte(location, offset: int) -> None:
+            """One-byte bit flip inside a replica, path or slab."""
+            if location.is_slab():
+                path, base, length = location.slab_extent()
+                pos = base + min(offset, length - 1)
+            else:
+                path = location.target
+                pos = offset
+            with open(path, "r+b") as f:
+                f.seek(pos)
+                byte = f.read(1)
+                f.seek(pos)
+                f.write(bytes([byte[0] ^ 0xFF]))
+
+        def read_bytes_total() -> float:
+            """cb_io_bytes_total{op=read} from the process registry —
+            every profiled location read in the process so far."""
+            for fam in get_registry().snapshot()["families"]:
+                if fam["name"] == "cb_io_bytes_total":
+                    return sum(s["value"] for s in fam["samples"]
+                               if s["labels"].get("op") == "read")
+            return 0.0
+
+        async def run_leg(root: str, planner: bool) -> dict:
+            cluster = make_cluster(root)
+            profile = cluster.get_profile(None)
+            for i, payload in enumerate(payloads):
+                await cluster.write_file(
+                    f"o{i:04d}", aio.BytesReader(payload), profile)
+            for i, slot, offset in damage:
+                ref = await cluster.get_file_ref(f"o{i:04d}")
+                flip_byte(ref.parts[0].data[slot].locations[0], offset)
+            before_nodes = {
+                row.key: row.completions
+                for row in cluster.health_scoreboard().stats().locations}
+            profiler, _reporter = new_profiler()
+            daemon = ScrubDaemon(cluster, bytes_per_sec=0,
+                                 planner=planner, profiler=profiler)
+            read_before = read_bytes_total()
+            stats = await daemon.run_once()
+            read_after = read_bytes_total()
+            if stats.corrupt != corrupt or stats.repaired < corrupt:
+                raise RuntimeError(
+                    f"leg planner={planner}: corrupt={stats.corrupt} "
+                    f"repaired={stats.repaired}, expected {corrupt}")
+            for i, _slot, _offset in damage:
+                ref = await cluster.get_file_ref(f"o{i:04d}")
+                body = await cluster.file_read_builder(ref).read_all()
+                assert body == payloads[i], \
+                    f"byte identity failed (planner={planner}, obj {i})"
+            repair_read = (read_after - read_before
+                           - stats.bytes_verified)
+            io_per_node = sorted(
+                row.completions - before_nodes.get(row.key, 0)
+                for row in cluster.health_scoreboard().stats().locations)
+            out = {
+                "repair_read_b": repair_read,
+                "bytes_per_rebuilt":
+                    repair_read / float(corrupt * chunk_bytes),
+                "wall_s": stats.last_pass_seconds,
+                "io_per_node": io_per_node,
+            }
+            if stats.repair is not None:
+                out["repair"] = stats.repair
+            await cluster.tunables.location_context().aclose()
+            return out
+
+        async def run() -> tuple:
+            with contextlib.ExitStack() as stack:
+                off_root = stack.enter_context(
+                    tempfile.TemporaryDirectory())
+                on_root = stack.enter_context(
+                    tempfile.TemporaryDirectory())
+                off = await run_leg(off_root, planner=False)
+                on = await run_leg(on_root, planner=True)
+            return off, on
+
+        off, on = asyncio.run(run())
+        reduction = (off["bytes_per_rebuilt"] / on["bytes_per_rebuilt"]
+                     if on["bytes_per_rebuilt"] > 0 else 0.0)
+        rep = on.get("repair", {})
+        print(f"# config 11: {objects} x {d}x{chunk_bytes >> 10} KiB "
+              f"objects d={d} p={p}, {corrupt} with one flipped byte, "
+              f"{block_kib} KiB blocks, mixed slab/path — repair reads "
+              f"{off['repair_read_b'] / 1024:.0f} KiB off vs "
+              f"{on['repair_read_b'] / 1024:.0f} KiB on "
+              f"({off['bytes_per_rebuilt']:.2f} vs "
+              f"{on['bytes_per_rebuilt']:.2f} B/rebuilt B, "
+              f"{reduction:.1f}x less) | scrub pass "
+              f"{off['wall_s']:.2f}s vs {on['wall_s']:.2f}s | plans "
+              f"copy/decode/fallback {rep.get('plans_copy', 0)}/"
+              f"{rep.get('plans_decode', 0)}/"
+              f"{rep.get('plans_fallback', 0)}", file=sys.stderr)
+        print(json.dumps({
+            "metric": metric,
+            "value": round(reduction, 2), "unit": "x",
+            # the acceptance target is a >= 3x reduction in repair
+            # bytes read per rebuilt byte; vs_baseline >= 1.0 = met
+            "vs_baseline": round(reduction / 3.0, 2),
+            "objects": objects, "corrupt": corrupt,
+            "chunk_kib": chunk_bytes >> 10, "block_kib": block_kib,
+            "repair_read_off_b": int(off["repair_read_b"]),
+            "repair_read_on_b": int(on["repair_read_b"]),
+            "bytes_per_rebuilt_off": round(
+                off["bytes_per_rebuilt"], 3),
+            "bytes_per_rebuilt_on": round(on["bytes_per_rebuilt"], 3),
+            "wall_off_s": round(off["wall_s"], 3),
+            "wall_on_s": round(on["wall_s"], 3),
+            "helper_b_replica_on": rep.get("helper_bytes_replica", 0),
+            "helper_b_decode_on": rep.get("helper_bytes_decode", 0),
+            "plans_copy": rep.get("plans_copy", 0),
+            "plans_decode": rep.get("plans_decode", 0),
+            "plans_fallback": rep.get("plans_fallback", 0),
+            "io_per_node_off": off["io_per_node"],
+            "io_per_node_on": on["io_per_node"],
+        }))
+    # lint: broad-except-ok the driver contract (ONE parseable JSON
+    # line, always) outranks the traceback; the error text carries it
+    except Exception as err:
+        print(json.dumps({
+            "metric": metric, "value": 0.0, "unit": "x",
+            "vs_baseline": 0.0,
+            "error": f"{type(err).__name__}: {err}",
+        }))
+        sys.exit(3)
+
+
 if __name__ == "__main__":
     # Bench measures the product defaults: the runtime concurrency
     # sanitizer (analysis/sanitizer.py) must stay OFF here even when an
@@ -1838,17 +2065,19 @@ if __name__ == "__main__":
                    "7": lambda: bench_gateway_put(sys.argv),
                    "8": lambda: bench_hedged_read(sys.argv),
                    "9": lambda: bench_gateway_scaleout(sys.argv),
-                   "10": lambda: bench_slab_store(sys.argv)}
+                   "10": lambda: bench_slab_store(sys.argv),
+                   "11": lambda: bench_repair_bandwidth(sys.argv)}
         idx = sys.argv.index("--config") + 1
         which = sys.argv[idx] if idx < len(sys.argv) else ""
         if which not in configs:
-            print(f"usage: bench.py [--config {{1,2,3,4,6,7,8,9,10}}] — "
-                  f"the device kernel metric (configs 2+3's compute "
+            print(f"usage: bench.py [--config {{1,2,3,4,6,7,8,9,10,11}}]"
+                  f" — the device kernel metric (configs 2+3's compute "
                   f"core) is the default no-arg run (got {which!r}); 6 "
                   f"is the hot-read cache A/B, 7 the gateway PUT ingest "
                   f"A/B, 8 the hedged-read tail-latency A/B, 9 the "
                   f"gateway scale-out multi-worker A/B, 10 the packed "
-                  f"slab store vs file-per-chunk A/B (all CPU-only)",
+                  f"slab store vs file-per-chunk A/B, 11 the "
+                  f"repair-bandwidth planner A/B (all CPU-only)",
                   file=sys.stderr)
             sys.exit(2)
         configs[which]()

@@ -47,13 +47,14 @@ from chunky_bits_tpu.analysis.rules import Finding, Rule
 
 #: the serve-path packages whose shared objects are per-event-loop by
 #: convention (cluster.py hands out batchers/caches loop-keyed);
-#: cluster/scrub.py rides along — the scrub daemon's task/counters are
+#: cluster/scrub.py and cluster/repair.py ride along — the scrub
+#: daemon's task/counters and the repair planner's metered I/O are
 #: exactly the loop/thread-handoff shape this family polices
 #: obs/ rides along: the metrics registry and trace buffer ARE shared
 #: process-wide by design — the rule makes each such site say so
 #: inline instead of growing silently
 LOOP_SCOPED_PATHS = ("gateway/", "file/", "parallel/", "obs/",
-                     "cluster/scrub.py")
+                     "cluster/scrub.py", "cluster/repair.py")
 
 #: class-body marker the CB204 pass reads: every public method of a
 #: ``LOOP_BOUND = True`` class must only ever run on the owning loop's

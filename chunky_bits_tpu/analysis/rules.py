@@ -16,9 +16,10 @@ from typing import Iterator
 #: unbounded wait or a non-daemon worker can hang a serve or block exit
 #: (file_part.py, destination.py and health.py joined with the hedged
 #: I/O scheduler: every await the read race / write failover adds must
-#: stay reachable through a timeout; slab.py and scrub.py joined with
-#: the packed store + scrub daemon: a long-running background walker
-#: is exactly the shape that hangs a shutdown if any wait is unbounded)
+#: stay reachable through a timeout; slab.py, scrub.py and repair.py
+#: joined with the packed store + scrub daemon + repair planner: a
+#: long-running background walker is exactly the shape that hangs a
+#: shutdown if any wait is unbounded)
 #: obs/ rides along: the metrics/tracing plane is called from every
 #: serve path, so a blocking or unbounded wait there stalls the same
 #: loops the rest of this list protects
@@ -26,7 +27,7 @@ DEVICE_NET_PATHS = ("ops/", "parallel/", "gateway/", "obs/",
                     "file/chunk_cache.py",
                     "file/file_part.py", "file/slab.py",
                     "cluster/destination.py", "cluster/health.py",
-                    "cluster/scrub.py")
+                    "cluster/scrub.py", "cluster/repair.py")
 
 ENV_PREFIX = "CHUNKY_BITS_TPU_"
 

@@ -98,6 +98,21 @@ def render_summary(stats: dict, healthz: dict, scrub: dict,
               f"corrupt={scrub.get('corrupt', 0)} "
               f"repaired={scrub.get('repaired', 0)} "
               f"running={scrub.get('running', False)}", file=out)
+        repair = scrub.get("repair")
+        if repair is not None:
+            # the planner's counters (cluster/repair.py RepairStats —
+            # the same numbers behind the cb_repair_* families)
+            helper = (repair.get("helper_bytes_replica", 0)
+                      + repair.get("helper_bytes_decode", 0))
+            ratio = repair.get("helper_bytes_per_rebuilt_byte")
+            line = (f"repair: plans copy={repair.get('plans_copy', 0)} "
+                    f"decode={repair.get('plans_decode', 0)} "
+                    f"fallback={repair.get('plans_fallback', 0)} "
+                    f"helperB={helper} "
+                    f"rebuiltB={repair.get('bytes_rebuilt', 0)}")
+            if ratio is not None:
+                line += f" helperB/rebuiltB={ratio:.2f}"
+            print(line, file=out)
     else:
         print("scrub: disabled", file=out)
 

@@ -213,6 +213,7 @@ class Cluster:
             .with_batch_parts(batch_parts)
             .with_encode_batcher(self._encode_batcher)
             .with_host_pipeline(self.host_pipeline())
+            .with_repair_block_bytes(self.tunables.repair_block_bytes)
         )
 
     async def write_file_ref(self, path: str,

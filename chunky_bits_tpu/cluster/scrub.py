@@ -114,6 +114,9 @@ class ScrubStats:
     rate_bytes_per_sec: float
     running: bool
     last_pass_seconds: Optional[float]
+    #: the repair planner's counter snapshot (RepairStats.to_obj), or
+    #: None when the daemon runs with the legacy repair shape
+    repair: Optional[dict] = None
 
     def to_obj(self) -> dict:
         return {
@@ -129,16 +132,26 @@ class ScrubStats:
             "rate_bytes_per_sec": self.rate_bytes_per_sec,
             **({"last_pass_seconds": round(self.last_pass_seconds, 3)}
                if self.last_pass_seconds is not None else {}),
+            **({"repair": self.repair}
+               if self.repair is not None else {}),
         }
 
     def __str__(self) -> str:
         rate = (f"{self.rate_bytes_per_sec:.0f}B/s"
                 if self.rate_bytes_per_sec > 0 else "unbounded")
+        plans = ""
+        if self.repair is not None:
+            plans = (f" plans={self.repair.get('plans_copy', 0)}c/"
+                     f"{self.repair.get('plans_decode', 0)}d/"
+                     f"{self.repair.get('plans_fallback', 0)}f")
+            ratio = self.repair.get("helper_bytes_per_rebuilt_byte")
+            if ratio is not None:
+                plans += f" helperB/rebuiltB={ratio:.2f}"
         return (f"Scrub<scanned={self.files_scanned}f/"
                 f"{self.chunks_scanned}c "
                 f"verified={self.bytes_verified}B "
-                f"corrupt={self.corrupt} repaired={self.repaired} | "
-                f"rate={rate}>")
+                f"corrupt={self.corrupt} repaired={self.repaired}"
+                f"{plans} | rate={rate}>")
 
 
 class ScrubDaemon:
@@ -148,11 +161,26 @@ class ScrubDaemon:
     ``start``/``stop`` run passes continuously with ``interval_seconds``
     of idle between them (the gateway's long-running mode).  ``repair``
     False turns detection-only mode on (report + demerit, never write).
+
+    ``planner`` True (the default) routes repair through the targeted
+    ``RepairPlanner`` (cluster/repair.py): block-localized ranged reads,
+    health-picked helpers, exact per-plan byte metering, in-place
+    rewrites that never republish metadata; the classic full
+    ``resilver`` runs only as its fallback.  ``planner`` False keeps
+    the legacy shape end to end — whole-replica copy beside a healthy
+    one, part-granular resilver for lost chunks — which is the OFF leg
+    of bench --config 11's repair-bandwidth A/B.
+
+    ``profiler`` (a file.profiler.Profiler) rides every location I/O
+    the pass makes — the per-read byte accounting bench --config 11
+    measures helper traffic with; None (the default) keeps the fused
+    no-profiler fast paths.
     """
 
     def __init__(self, cluster, bytes_per_sec: Optional[float] = None,
                  interval_seconds: float = 60.0, repair: bool = True,
-                 profile_name: Optional[str] = None) -> None:
+                 profile_name: Optional[str] = None,
+                 planner: bool = True, profiler=None) -> None:
         self.cluster = cluster
         rate = (cluster.tunables.scrub_bytes_per_sec
                 if bytes_per_sec is None else float(bytes_per_sec))
@@ -160,7 +188,17 @@ class ScrubDaemon:
         self.interval_seconds = max(float(interval_seconds), 0.0)
         self.repair = repair
         self.profile_name = profile_name
+        self.profiler = profiler
         self._bucket = TokenBucket(self.rate)
+        if planner:
+            from chunky_bits_tpu.cluster.repair import RepairPlanner
+
+            self._planner: Optional[RepairPlanner] = RepairPlanner(
+                health=cluster.health_scoreboard(),
+                bucket=self._bucket,
+                backend=cluster.tunables.backend)
+        else:
+            self._planner = None
         self._task: Optional[asyncio.Task] = None
         # counters are read by profiler reports and the gateway status
         # handler (possibly from another thread than the pass loop's)
@@ -202,6 +240,8 @@ class ScrubDaemon:
                 rate_bytes_per_sec=self.rate,
                 running=self._task is not None and not self._task.done(),
                 last_pass_seconds=self._last_pass_seconds,
+                repair=(self._planner.stats().to_obj()
+                        if self._planner is not None else None),
             )
 
     # ---- the walk ----
@@ -240,34 +280,37 @@ class ScrubDaemon:
         return 1
 
     async def _verify_chunk(self, chunk, location, cx, pipe
-                            ) -> Optional[bool]:
-        """True = replica matches its golden digest, False = corrupt,
-        None = unreadable.  Fused native hashing where the replica is
-        local/packed (bytes never surface to Python); generic
-        read+verify otherwise.  The byte budget is taken BEFORE the
-        I/O — the bound meters bytes touched, not bytes that happened
-        to verify."""
+                            ) -> tuple[Optional[bool], Optional[bytes]]:
+        """(verdict, corrupt bytes): verdict True = replica matches its
+        golden digest, False = corrupt, None = unreadable.  Fused
+        native hashing where the replica is local/packed (bytes never
+        surface to Python); generic read+verify otherwise — and when
+        THAT path finds corruption, the bytes it already holds ride
+        back so the repair planner localizes damage without re-reading
+        the victim.  The byte budget is taken BEFORE the I/O — the
+        bound meters bytes touched, not bytes that happened to
+        verify."""
         from chunky_bits_tpu.file.file_part import _hash_local_fused
 
         nbytes = None
         try:
             nbytes = await location.file_len(cx)
         except LocationError:
-            return None
+            return None, None
         await self._bucket.take(nbytes)
         digest = await _hash_local_fused(chunk, location, cx, pipe)
         if digest is not None:
             self._bump(bytes=nbytes)
-            return digest == chunk.hash.value.digest
+            return digest == chunk.hash.value.digest, None
         try:
             data = await location.read(cx)
         except LocationError:
-            return None
+            return None, None
         self._bump(bytes=len(data))
         ok = await pipe.run(
             "verify", lambda: chunk.hash.verify(data),
             nbytes=len(data))
-        return bool(ok)
+        return bool(ok), (None if ok else bytes(data))
 
     async def _rewrite_replicas(self, chunk, source, victims, cx,
                                 pipe) -> None:
@@ -303,52 +346,85 @@ class ScrubDaemon:
 
     async def _scrub_ref(self, path: str, ref, cx, pipe,
                          snapshot: str) -> None:
-        """Verify every replica of every chunk of one file; resilver
-        damaged parts (missing or corrupt replicas) in place and
-        republish the metadata, the same sequence as the CLI's
-        ``resilver`` command.  ``snapshot`` is the canonical serialized
-        form of ``ref`` as fetched — the republish is fenced on the
+        """Verify every replica of every chunk of one file, then repair
+        the damage.  With the planner (the default) repair is targeted
+        and in place — block-localized ranged reads, health-picked
+        helpers, no metadata republish — and only parts the planner
+        hands back fall through to the classic full ``resilver``; with
+        ``planner=False`` every damaged part takes the legacy sequence
+        (whole-replica rewrite beside a healthy one, part-granular
+        resilver for lost chunks), the same as the CLI's ``resilver``
+        command.  ``snapshot`` is the canonical serialized form of
+        ``ref`` as fetched — the resilver republish is fenced on the
         stored metadata still matching it, so a client overwrite that
         landed while this (rate-bounded, possibly long) scrub was
         running is never clobbered with a stale repaired ref."""
         health = self.cluster.health_scoreboard()
         damaged_parts = []
         for part in ref.parts:
+            # verify phase: one verdict per replica (True verified,
+            # False corrupt, None unreadable) — the planner's input
+            verdicts = []
+            # corrupt-replica bytes the generic verify path already
+            # surfaced, keyed (chunk index, location) — the planner
+            # localizes from these instead of re-reading the victim;
+            # scoped to ONE part, so memory stays bounded by the
+            # (rare) corrupt replicas of the part in hand
+            payloads: dict = {}
             part_damaged = False
-            for chunk in part.data + part.parity:
+            for ci, chunk in enumerate(part.data + part.parity):
                 self._bump(chunks=1)
-                good = None
-                victims = []  # corrupt/missing replicas to rewrite
+                per_loc = []
+                if not chunk.locations:
+                    # a chunk with no replicas at all: nothing to
+                    # verify, but the part needs repair (resilver
+                    # places a new replica — the planner hands it back)
+                    part_damaged = True
                 for location in chunk.locations:
-                    verdict = await self._verify_chunk(
+                    verdict, payload = await self._verify_chunk(
                         chunk, location, cx, pipe)
-                    if verdict is True:
-                        if good is None:
-                            good = location
-                    elif verdict is False:
+                    if verdict is False:
                         # corrupt content on a successful transfer is
                         # still a demerit for the node serving it —
                         # the same rule as the read path's _corrupt
                         self._bump(corrupt=1)
                         health.record(location, False)
-                        victims.append(location)
-                    else:
+                        part_damaged = True
+                        if payload is not None:
+                            payloads[(ci, location)] = payload
+                    elif verdict is None:
                         self._bump(unavailable=1)
-                        victims.append(location)
+                        part_damaged = True
+                    per_loc.append((location, verdict))
+                verdicts.append(per_loc)
+            if not part_damaged or not self.repair:
+                continue
+            if self._planner is not None:
+                outcome = await self._planner.repair_part(
+                    part, verdicts, cx, pipe, payloads=payloads)
+                self._bump(repaired=outcome.repaired,
+                           repair_failures=outcome.failures)
+                if outcome.fallback:
+                    damaged_parts.append(part)
+                continue
+            # legacy shape (bench --config 11's OFF leg): whole-replica
+            # rewrite beside a healthy one — resilver only rebuilds
+            # chunks with NO valid replica (chunk_status
+            # short-circuit), so without this the same rotten extent
+            # would be re-detected (and the node re-demerited) every
+            # pass forever — and part-granular resilver for the rest
+            part_lost = False
+            for chunk, per_loc in zip(part.data + part.parity,
+                                      verdicts):
+                good = next(
+                    (loc for loc, v in per_loc if v is True), None)
+                victims = [loc for loc, v in per_loc if v is not True]
                 if good is None:
-                    # no valid replica anywhere: this is resilver's
-                    # job (rebuild from the part's other chunks)
-                    part_damaged = True
-                elif victims and self.repair:
-                    # a corrupt/missing replica BESIDE a healthy one is
-                    # rewritten in place with the verified bytes —
-                    # resilver only rebuilds chunks with NO valid
-                    # replica (chunk_status short-circuit), so without
-                    # this the same rotten extent would be re-detected
-                    # (and the node re-demerited) every pass forever
+                    part_lost = True
+                elif victims:
                     await self._rewrite_replicas(chunk, good, victims,
                                                  cx, pipe)
-            if part_damaged:
+            if part_lost:
                 damaged_parts.append(part)
         self._bump(files=1)
         if not damaged_parts or not self.repair:
@@ -407,6 +483,11 @@ class ScrubDaemon:
         hours-stale metadata."""
         started = time.monotonic()
         cx = self.cluster.tunables.location_context()
+        if self.profiler is not None:
+            # per-read byte accounting for the pass (bench --config 11
+            # measures helper traffic this way); disables the fused
+            # no-profiler fast paths, identically for every leg
+            cx = cx.but_with(profiler=self.profiler)
         pipe = self.cluster.host_pipeline()
         paths = await self._list_file_paths()
         scored: list[tuple[int, str]] = []

@@ -98,6 +98,18 @@ GATEWAY_SENDFILE_ENV = "CHUNKY_BITS_TPU_GATEWAY_SENDFILE"
 #: serve / `chunky-bits scrub`).
 SCRUB_BYTES_PER_SEC_ENV = "CHUNKY_BITS_TPU_SCRUB_BYTES_PER_SEC"
 
+#: per-chunk block-digest tree granularity in bytes (file/chunk.py
+#: BlockDigests + cluster/repair.py): chunks longer than this get a
+#: sha256-per-block tree written into their file-reference metadata on
+#: the normal encode path, so scrub/verify localize corruption to block
+#: ranges and the repair planner moves ≈damage bytes off helpers
+#: instead of d whole chunks.  0/unset = off (the default — the tree
+#: costs metadata bytes and one extra hash pass, so it is opt-in per
+#: the measure-before-defaulting invariant; bench --config 11 is the
+#: A/B).  YAML ``repair_block_bytes`` wins; the env var supplies the
+#: default.  Read when a file writer is built.
+REPAIR_BLOCK_BYTES_ENV = "CHUNKY_BITS_TPU_REPAIR_BLOCK_BYTES"
+
 #: slow-request tracing threshold in milliseconds (obs/tracing.py +
 #: gateway/http.py): requests at least this slow are retained — with
 #: per-plane spans — in the slowest-N buffer served at /debug/traces.
@@ -257,6 +269,18 @@ def scrub_bytes_per_sec(*, default: float = 0.0) -> float:
     return v if v > 0 else default
 
 
+def repair_block_bytes(*, default: int = 0) -> int:
+    """Env-supplied default for the ``repair_block_bytes`` tunable
+    (YAML wins; 0 = no block-digest trees written).  Lenient like
+    ``cache_bytes`` — malformed or negative values read as off."""
+    raw = os.environ.get(REPAIR_BLOCK_BYTES_ENV, "")
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
 def trace_slow_ms(*, default: float = 0.0) -> float:
     """Env-supplied default for the ``trace_slow_ms`` tunable (YAML
     wins; 0 = request tracing off).  Lenient like ``hedge_ms`` —
@@ -302,6 +326,12 @@ def _default_trace_slow_ms() -> float:
     """Env-supplied default for the ``trace_slow_ms`` tunable (YAML
     wins; 0 = request tracing off)."""
     return trace_slow_ms(default=0.0)
+
+
+def _default_repair_block_bytes() -> int:
+    """Env-supplied default for the ``repair_block_bytes`` tunable
+    (YAML wins; 0 = block-digest trees off)."""
+    return repair_block_bytes(default=0)
 
 
 def _default_host_threads() -> int:
@@ -353,6 +383,12 @@ class Tunables:
     #: registry is always on).  YAML wins;
     #: ``CHUNKY_BITS_TPU_TRACE_SLOW_MS`` supplies the default.
     trace_slow_ms: float = field(default_factory=_default_trace_slow_ms)
+    #: block-digest tree granularity for damage localization
+    #: (file/chunk.py BlockDigests); 0 keeps the trees off (the
+    #: default).  YAML wins; ``CHUNKY_BITS_TPU_REPAIR_BLOCK_BYTES``
+    #: supplies the default.
+    repair_block_bytes: int = field(
+        default_factory=_default_repair_block_bytes)
 
     def is_device_backend(self) -> bool:
         """True when the erasure plane runs on an accelerator ("jax" or a
@@ -437,6 +473,16 @@ class Tunables:
             if trace_v < 0:
                 raise SerdeError(
                     f"trace_slow_ms must be >= 0, got {trace_v}")
+        repair_v = obj.get("repair_block_bytes", None)
+        if repair_v is not None:
+            try:
+                repair_v = int(repair_v)
+            except (TypeError, ValueError) as err:
+                raise SerdeError(
+                    f"invalid repair_block_bytes {repair_v!r}") from err
+            if repair_v < 0:
+                raise SerdeError(
+                    f"repair_block_bytes must be >= 0, got {repair_v}")
         return cls(
             https_only=bool(obj.get("https_only", False)),
             on_conflict=on_conflict,
@@ -454,6 +500,8 @@ class Tunables:
                if scrub_v is not None else {}),
             **({"trace_slow_ms": trace_v}
                if trace_v is not None else {}),
+            **({"repair_block_bytes": repair_v}
+               if repair_v is not None else {}),
         )
 
     def to_obj(self) -> dict:
@@ -476,6 +524,8 @@ class Tunables:
             obj["scrub_bytes_per_sec"] = self.scrub_bytes_per_sec
         if self.trace_slow_ms > 0:
             obj["trace_slow_ms"] = self.trace_slow_ms
+        if self.repair_block_bytes > 0:
+            obj["repair_block_bytes"] = self.repair_block_bytes
         return obj
 
     def location_context(self) -> LocationContext:

@@ -651,6 +651,7 @@ class FilePart:
         length: int,
         precomputed: Optional[tuple] = None,
         pipeline: Optional[HostPipeline] = None,
+        block_bytes: int = 0,
     ) -> "FilePart":
         """Encode one part and write all d+p shards concurrently,
         failing fast on the first shard error.
@@ -658,7 +659,14 @@ class FilePart:
         ``precomputed`` is ``(shards, parity, buf_length)`` or
         ``(shards, parity, buf_length, digests)`` from a staging layer;
         ``digests`` (32-byte sha256 per shard, data then parity — the
-        fused encode+hash output) skips re-hashing here."""
+        fused encode+hash output) skips re-hashing here.
+
+        ``block_bytes`` > 0 additionally writes a per-chunk block-digest
+        tree (file/chunk.py BlockDigests, the ``repair_block_bytes``
+        tunable) into each chunk longer than one block, computed on the
+        same host-pipeline hash stage the per-shard SHA runs on — the
+        damage-localization metadata the repair planner
+        (cluster/repair.py) schedules sub-chunk rebuilds from."""
         pipe = _pipe(pipeline)
         digests: Optional[list] = None
         if precomputed is not None:
@@ -693,11 +701,22 @@ class FilePart:
                     "hash",
                     lambda payload=payload: AnyHash.from_buf(payload),
                     nbytes=_buf_len(payload))
+            blocks = None
+            if block_bytes > 0 and _buf_len(payload) > block_bytes:
+                # single-block chunks carry no tree: the chunk hash
+                # already localizes damage to the whole (one) block
+                from chunky_bits_tpu.file.chunk import BlockDigests
+
+                blocks = await pipe.run(
+                    "hash",
+                    lambda payload=payload: BlockDigests.from_buf(
+                        payload, block_bytes),
+                    nbytes=_buf_len(payload))
             try:
                 locations = await writer.write_shard(hash_, payload)
             except ShardError as err:
                 raise FileWriteError(str(err)) from err
-            return Chunk(hash=hash_, locations=locations)
+            return Chunk(hash=hash_, locations=locations, blocks=blocks)
 
         payloads = list(shards) + list(parity)
         pre_digests = digests if digests is not None \

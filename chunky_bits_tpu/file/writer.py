@@ -78,6 +78,11 @@ class FileWriteBuilder:
     #: workers), or None for the process-shared one.  The scaling sweeps
     #: (bench --config 2 --sweep-threads) inject per-N instances here.
     host_pipeline: object = None
+    #: block-digest tree granularity (the ``repair_block_bytes``
+    #: tunable): chunks longer than this get a per-block sha256 tree in
+    #: their metadata for damage localization (cluster/repair.py);
+    #: 0 = off
+    repair_block_bytes: int = 0
 
     # builder setters (writer.rs:78-110); return copies like the Rust
     # builder's consume-and-return
@@ -115,6 +120,10 @@ class FileWriteBuilder:
 
     def with_host_pipeline(self, host_pipeline) -> "FileWriteBuilder":
         return replace(self, host_pipeline=host_pipeline)
+
+    def with_repair_block_bytes(self, repair_block_bytes: int
+                                ) -> "FileWriteBuilder":
+        return replace(self, repair_block_bytes=repair_block_bytes)
 
     async def write(self, reader: aio.AsyncByteReader) -> FileReference:
         if self.concurrency <= 1:
@@ -257,7 +266,9 @@ class FileWriteBuilder:
         async def write_part(precomputed) -> FilePart:
             try:
                 return await FilePart.write_with_coder(
-                    coder, destination, b"", 0, precomputed=precomputed
+                    coder, destination, b"", 0, precomputed=precomputed,
+                    pipeline=pipeline,
+                    block_bytes=self.repair_block_bytes,
                 )
             finally:
                 sem.release()

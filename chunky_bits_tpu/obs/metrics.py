@@ -9,8 +9,9 @@ cluster/health.py) is the single sink behind the existing stat sources:
   :func:`record_request` / :func:`record_io` helpers; the gateway's
   admission/shed counters increment registry counters directly;
 * **polled sources** — ``ChunkCache``, ``HostPipeline``,
-  ``HealthScoreboard`` and ``ScrubDaemon`` self-register (weakly) at
-  construction and are snapshot at scrape time off their existing
+  ``HealthScoreboard``, ``ScrubDaemon`` and ``RepairPlanner``
+  self-register (weakly) at construction and are snapshot at scrape
+  time off their existing
   ``stats()`` dataclasses, so one ``GET /metrics`` shows the whole
   system while the ``Profiler`` stanzas keep rendering on top of the
   same numbers.
@@ -255,8 +256,9 @@ class MetricsRegistry:
 
     def register_source(self, kind: str, obj: object) -> None:
         """Weakly register a stat source (``kind`` one of "cache",
-        "pipeline", "health", "scrub"); its ``stats()`` snapshot is
-        folded into every registry snapshot while the object lives.
+        "pipeline", "health", "scrub", "repair"); its ``stats()``
+        snapshot is folded into every registry snapshot while the
+        object lives.
         Registration never extends the object's lifetime, so per-loop
         caches and sweep-pinned pipelines drop out with their owners."""
         with self._lock:
@@ -494,6 +496,42 @@ def _source_families(reg: MetricsRegistry) -> list[dict]:
             "breaker state (0 closed, 1 half-open, 2 open)", [
                 _scalar(breaker_rank.get(row["breaker"], 2), node=node)
                 for node, row in sorted(nodes.items())]))
+
+    repairs = [r.stats().to_obj() for r in reg._live_sources("repair")]
+    if repairs:
+        s = _sum_rows(repairs, ("plans_copy", "plans_decode",
+                                "plans_fallback",
+                                "helper_bytes_replica",
+                                "helper_bytes_decode",
+                                "bytes_localized", "bytes_rebuilt",
+                                "bytes_written", "ranges_rebuilt",
+                                "verify_failures"))
+        # plan kinds and helper-read sources are CLOSED label sets
+        # (CB107): copy = 1x from a healthy replica, decode = ranged
+        # reads off d helpers, fallback = handed to full resilver
+        fams.append(_fam("cb_repair_plans_total", COUNTER,
+                         "repair plans executed by kind", [
+                             _scalar(s["plans_copy"], kind="copy"),
+                             _scalar(s["plans_decode"], kind="decode"),
+                             _scalar(s["plans_fallback"],
+                                     kind="fallback")]))
+        fams.append(_fam("cb_repair_helper_bytes_total", COUNTER,
+                         "bytes read off helpers for repair by source",
+                         [_scalar(s["helper_bytes_replica"],
+                                  source="replica"),
+                          _scalar(s["helper_bytes_decode"],
+                                  source="decode")]))
+        for key, help_ in (
+                ("bytes_localized",
+                 "victim bytes re-read to localize damage"),
+                ("bytes_rebuilt", "damaged bytes rebuilt in place"),
+                ("bytes_written", "repair bytes written to victims"),
+                ("ranges_rebuilt", "damaged ranges rebuilt"),
+                ("verify_failures",
+                 "rebuilt chunks failing the end-to-end hash gate")):
+            fams.append(_fam(f"cb_repair_{key}_total", COUNTER,
+                             f"repair planner: {help_}",
+                             [_scalar(s[key])]))
 
     scrubs = [s.stats().to_obj() for s in reg._live_sources("scrub")]
     if scrubs:

@@ -48,6 +48,10 @@ def cluster_spec(meta_path: str) -> dict:
         "metadata": {"type": "path", "format": "yaml", "path": meta_path},
         "profiles": {"default": {"data": 3, "parity": 2,
                                  "chunk_size": 12}},
+        # pinned OFF in YAML (which wins over any inherited
+        # $CHUNKY_BITS_TPU_REPAIR_BLOCK_BYTES): these fixtures freeze
+        # the CLASSIC wire format; fixture 5 freezes the tree format
+        "tunables": {"repair_block_bytes": 0},
     }
 
 
@@ -108,6 +112,18 @@ async def build_refs() -> dict[str, dict]:
             refs["slab_placement"] = ref.to_obj()
         finally:
             os.chdir(cwd)
+
+    # 5. fixture 1's exact payload with per-chunk block-digest trees
+    # (the `repair_block_bytes` tunable, file/chunk.py BlockDigests):
+    # pins the tree wire format AND that the trees are strictly
+    # additive — stripping every `blocks` key must reproduce fixture 1
+    # byte-for-byte (tests/test_golden.py asserts both directions)
+    ref = await (FileWriteBuilder()
+                 .with_chunk_size(1 << 14)
+                 .with_data_chunks(3).with_parity_chunks(2)
+                 .with_repair_block_bytes(4096)
+                 .write(aio.BytesReader(payload(100_000, 1))))
+    refs["block_digests"] = ref.to_obj()
     return refs
 
 

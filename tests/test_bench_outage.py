@@ -151,6 +151,52 @@ def test_config10_failure_emits_one_json_line():
     assert "error" in rec
 
 
+def test_config11_smoke_emits_one_json_line():
+    """--config 11 --smoke (repair-bandwidth planner A/B at CI scale)
+    honors the driver contract: exactly one parseable JSON line on
+    stdout with the required keys plus the A/B fields, exit 0 — and
+    the run itself asserts repaired objects byte-identical to their
+    payloads on both legs."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "11", "--smoke"],
+        cwd=REPO, env=env, capture_output=True, timeout=300)
+    assert r.returncode == 0, r.stderr.decode()[-800:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline",
+                "bytes_per_rebuilt_off", "bytes_per_rebuilt_on",
+                "repair_read_off_b", "repair_read_on_b",
+                "wall_off_s", "wall_on_s", "plans_decode",
+                "io_per_node_off", "io_per_node_on"):
+        assert key in rec
+    assert rec["value"] > 0
+    assert rec["unit"] == "x"
+    # the planner's structural win: strictly fewer repair bytes read
+    # per rebuilt byte than the part-granular legacy leg
+    assert rec["bytes_per_rebuilt_on"] < rec["bytes_per_rebuilt_off"]
+
+
+def test_config11_failure_emits_one_json_line():
+    """ANY --config 11 failure (here: invalid parameters) still
+    produces exactly one parseable JSON line and exit 3 — the same
+    contract as configs 8/9/10 and the device runs."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "11",
+         "--corrupt", "0"],
+        cwd=REPO, env=env, capture_output=True, timeout=120)
+    assert r.returncode == 3, r.stderr.decode()[-500:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec
+    assert rec["value"] == 0.0
+    assert "error" in rec
+
+
 def test_seams_only_shrink_and_tolerate_garbage():
     """Inherited env values must not break the contract: malformed or
     larger-than-default values fall back to the real budget."""

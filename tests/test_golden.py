@@ -25,7 +25,7 @@ def golden_text(name: str) -> str:
 def test_fixtures_match_current_behavior():
     refs = asyncio.run(gen.build_refs())
     assert set(refs) == {"void_small", "void_wide", "cluster_placement",
-                         "slab_placement"}
+                         "slab_placement", "block_digests"}
     for name, obj in refs.items():
         assert gen.dump(obj) == golden_text(name), (
             f"golden fixture {name} drifted — wire compatibility broken "
@@ -47,6 +47,52 @@ def test_slab_fixture_mirrors_path_placement():
             assert p_chunk["sha256"] == s_chunk["sha256"]
             assert [f"slab:{loc}" for loc in p_chunk["locations"]] \
                 == s_chunk["locations"]
+
+
+def test_block_digest_fixture_is_strictly_additive():
+    """Fixture 5 differs from fixture 1 ONLY by the ``blocks`` trees:
+    same content addresses, same structure — damage localization is
+    metadata on top of the classic wire format, never a format fork."""
+    import yaml
+
+    plain = yaml.safe_load(golden_text("void_small"))
+    treed = yaml.safe_load(golden_text("block_digests"))
+    stripped = yaml.safe_load(golden_text("block_digests"))
+    for part in stripped["parts"]:
+        for chunk in part["data"] + part.get("parity", []):
+            chunk.pop("blocks", None)
+    assert stripped == plain, (
+        "block_digests minus its trees must BE void_small")
+    # and the trees themselves verify against the frozen chunk hashes:
+    # tree blocks re-hash to the digests, digest count covers chunksize
+    from chunky_bits_tpu.file.file_reference import FileReference
+
+    ref = FileReference.from_obj(treed)
+    for part in ref.parts:
+        for chunk in part.data + part.parity:
+            if part.chunksize <= 4096:
+                assert chunk.blocks is None  # single-block: no tree
+                continue
+            assert chunk.blocks is not None
+            assert chunk.blocks.size == 4096
+            assert chunk.blocks.covers(part.chunksize)
+
+
+def test_old_reference_without_blocks_parses_and_roundtrips():
+    """The compat direction: references written before the tunable
+    (every other fixture) parse with ``blocks is None`` and serialize
+    back WITHOUT the key — an old ref passing through this framework
+    is byte-preserved, never upgraded in place."""
+    import yaml
+
+    from chunky_bits_tpu.file.file_reference import FileReference
+
+    obj = yaml.safe_load(golden_text("void_small"))
+    ref = FileReference.from_obj(obj)
+    for part in ref.parts:
+        for chunk in part.data + part.parity:
+            assert chunk.blocks is None
+    assert gen.dump(ref.to_obj()) == golden_text("void_small")
 
 
 @pytest.mark.parametrize("backend", ["numpy", "native", "jax"])

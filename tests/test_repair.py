@@ -1,0 +1,518 @@
+"""Targeted repair planner (cluster/repair.py) + block-digest trees
+(file/chunk.py BlockDigests).
+
+Pins the PR's acceptance criteria: damage localizes to block ranges and
+repairs move ≈damage bytes instead of d whole chunks (exact helper-byte
+counts asserted); repaired replicas are byte-identical across
+numpy/native/jax backends and against a whole-part rebuild oracle;
+references without trees still parse, verify and repair exactly as
+before; and every byte of repair I/O — victim re-reads, helper range
+reads, repair writes — is observable in the scrub token bucket and the
+``cb_repair_*`` counters (no unmetered helper reads).
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from chunky_bits_tpu.cluster import Cluster
+from chunky_bits_tpu.cluster.repair import RepairPlanner, merge_ranges
+from chunky_bits_tpu.cluster.scrub import ScrubDaemon
+from chunky_bits_tpu.file.chunk import BlockDigests
+from chunky_bits_tpu.file.location import Location
+from chunky_bits_tpu.utils import aio
+from tests.test_slab import make_cluster_obj
+
+
+def write_payload(cluster, name, nbytes, seed=0):
+    payload = np.random.default_rng(seed).integers(
+        0, 256, nbytes, dtype=np.uint8).tobytes()
+
+    async def run():
+        await cluster.write_file(name, aio.BytesReader(payload),
+                                 cluster.get_profile())
+
+    asyncio.run(run())
+    return payload
+
+
+def flip_byte(location, offset):
+    """One-byte corruption at a chunk offset, path or slab replica."""
+    if location.is_slab():
+        path, base, length = location.slab_extent()
+        pos = base + min(offset, length - 1)
+    else:
+        path = location.target
+        pos = offset
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        byte = f.read(1)
+        f.seek(pos)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+
+def meter_bucket(daemon):
+    """Spy on the daemon's token bucket: every take() the pass makes
+    (verification AND planner repair I/O share the one bucket) lands in
+    the returned list."""
+    taken = []
+    orig = daemon._bucket.take
+
+    async def spy(nbytes):
+        taken.append(nbytes)
+        await orig(nbytes)
+
+    daemon._bucket.take = spy
+    return taken
+
+
+# ---- BlockDigests unit behavior ----
+
+def test_block_digests_localize_and_verify():
+    data = bytearray(np.random.default_rng(0).integers(
+        0, 256, 10_000, dtype=np.uint8).tobytes())
+    bd = BlockDigests.from_buf(data, 4096)
+    assert len(bd.digests) == 3 and bd.covers(10_000)
+    assert bd.damaged_ranges(data) == []
+    data[5000] ^= 1
+    assert bd.damaged_ranges(data) == [(4096, 4096)]
+    data[5000] ^= 1  # restore; damage blocks 0 and 2 (non-adjacent)
+    data[0] ^= 1
+    data[9000] ^= 1
+    assert bd.damaged_ranges(data) == [(0, 4096), (8192, 1808)]
+    data[5000] ^= 1  # all three damaged: adjacent ranges merge
+    assert bd.damaged_ranges(data) == [(0, 10_000)]
+    data[0] ^= 1
+    data[9000] ^= 1
+    # truncated/grown replicas cannot localize
+    assert bd.damaged_ranges(data[:5]) is None
+    assert bd.damaged_ranges(data + b"x" * 5000) is None
+    # range verification: aligned whole blocks judged, others abstain
+    assert bd.verify_range(bytes(data[4096:8192]), 4096) is False
+    data[5000] ^= 1  # restore block 1: data fully intact again
+    assert bd.verify_range(bytes(data[4096:8192]), 4096) is True
+    assert bd.verify_range(bytes(data[8192:]), 8192) is True
+    data[9000] ^= 1
+    assert bd.verify_range(bytes(data[8192:]), 8192) is False
+    assert bd.verify_range(bytes(data[1:4097]), 1) is None
+    assert bd.verify_range(b"", 0) is None
+
+
+def test_block_digests_serde_and_lenient_parse():
+    bd = BlockDigests.from_buf(b"hello world" * 1000, 1024)
+    assert BlockDigests.from_obj(bd.to_obj()) == bd
+    for garbage in (None, 7, [], {}, {"size": 0, "sha256": []},
+                    {"size": 1024}, {"size": 1024, "sha256": ["zz"]},
+                    {"size": "x", "sha256": []}):
+        assert BlockDigests.from_obj(garbage) is None
+
+
+def test_merge_ranges():
+    assert merge_ranges([]) == []
+    assert merge_ranges([(0, 10), (10, 5)]) == [(0, 15)]
+    assert merge_ranges([(20, 5), (0, 10)]) == [(0, 10), (20, 5)]
+    assert merge_ranges([(0, 10), (5, 10), (30, 2)]) == [(0, 15),
+                                                         (30, 2)]
+    assert merge_ranges([(0, 30), (5, 10)]) == [(0, 30)]
+
+
+def test_repair_block_bytes_tunable_serde_and_env(tmp_path,
+                                                  monkeypatch):
+    from chunky_bits_tpu.cluster.tunables import (
+        REPAIR_BLOCK_BYTES_ENV,
+        Tunables,
+    )
+
+    monkeypatch.delenv(REPAIR_BLOCK_BYTES_ENV, raising=False)
+    t = Tunables.from_obj({"repair_block_bytes": 1 << 20})
+    assert t.repair_block_bytes == 1 << 20
+    assert t.to_obj()["repair_block_bytes"] == 1 << 20
+    assert "repair_block_bytes" not in Tunables.from_obj(None).to_obj()
+    with pytest.raises(Exception):
+        Tunables.from_obj({"repair_block_bytes": -1})
+    monkeypatch.setenv(REPAIR_BLOCK_BYTES_ENV, "4096")
+    assert Tunables.from_obj(None).repair_block_bytes == 4096
+    monkeypatch.setenv(REPAIR_BLOCK_BYTES_ENV, "garbage")
+    assert Tunables.from_obj(None).repair_block_bytes == 0
+    # YAML wins over the env default
+    monkeypatch.setenv(REPAIR_BLOCK_BYTES_ENV, "4096")
+    assert Tunables.from_obj(
+        {"repair_block_bytes": 0}).repair_block_bytes == 0
+
+
+def test_encode_path_writes_trees_only_for_multiblock_chunks(tmp_path):
+    cluster = Cluster.from_obj(make_cluster_obj(
+        tmp_path, chunk_log2=14,
+        tunables={"repair_block_bytes": 4096}))
+    write_payload(cluster, "big", 3 * (1 << 14), seed=1)  # 16 KiB chunks
+    write_payload(cluster, "small", 600, seed=2)  # 200 B chunks
+
+    async def main():
+        big = await cluster.get_file_ref("big")
+        for chunk in big.parts[0].data + big.parts[0].parity:
+            assert chunk.blocks is not None
+            assert chunk.blocks.size == 4096
+            assert chunk.blocks.covers(big.parts[0].chunksize)
+        small = await cluster.get_file_ref("small")
+        for chunk in small.parts[0].data + small.parts[0].parity:
+            assert chunk.blocks is None  # one block: hash suffices
+
+    asyncio.run(main())
+
+
+# ---- the planner's plans, with exact byte accounting ----
+
+@pytest.mark.parametrize("packed", [True, False])
+def test_decode_plan_reads_d_blocks_not_d_chunks(tmp_path, packed):
+    """One flipped byte in the only replica of one chunk: the planner
+    reads the SAME damaged block off d helpers (3 x 4 KiB), not d whole
+    chunks — and repairs in place without touching metadata."""
+    cluster = Cluster.from_obj(make_cluster_obj(
+        tmp_path, packed=packed, chunk_log2=14,
+        tunables={"repair_block_bytes": 4096}))
+    payload = write_payload(cluster, "obj", 3 * (1 << 14), seed=3)
+
+    async def main():
+        ref = await cluster.get_file_ref("obj")
+        meta_path = os.path.join(str(tmp_path), "meta", "obj")
+        with open(meta_path, "rb") as f:
+            meta_before = f.read()
+        flip_byte(ref.parts[0].data[1].locations[0], 5000)
+        daemon = ScrubDaemon(cluster, bytes_per_sec=0)
+        taken = meter_bucket(daemon)
+        stats = await daemon.run_once()
+        rs = stats.repair
+        assert stats.corrupt == 1 and stats.repaired == 1
+        assert rs["plans_decode"] == 1 and rs["plans_copy"] == 0
+        assert rs["helper_bytes_decode"] == 3 * 4096
+        assert rs["helper_bytes_replica"] == 0
+        assert rs["bytes_localized"] == 1 << 14  # one victim re-read
+        assert rs["bytes_rebuilt"] == 4096
+        assert rs["bytes_written"] == 1 << 14
+        # every byte of the pass is in the token-bucket accounting:
+        # verification + localization + helper reads + repair writes
+        assert sum(taken) == (stats.bytes_verified
+                              + rs["bytes_localized"]
+                              + rs["helper_bytes_decode"]
+                              + rs["bytes_written"])
+        # in-place repair: the stored metadata was never republished
+        with open(meta_path, "rb") as f:
+            assert f.read() == meta_before
+        got = await cluster.file_read_builder(
+            await cluster.get_file_ref("obj")).read_all()
+        assert got == payload
+        verify = await (await cluster.get_file_ref("obj")).verify(
+            cluster.tunables.location_context())
+        assert str(verify.integrity()) == "Valid"
+        # converged: the next pass finds nothing new
+        stats2 = await daemon.run_once()
+        assert stats2.corrupt == stats.corrupt
+
+    asyncio.run(main())
+
+
+def test_verify_phase_bytes_make_localization_free(tmp_path):
+    """When verification runs the generic read path (here: a profiler
+    rides the pass), the corrupt replica's bytes ride into the planner
+    and localization costs ZERO extra I/O — repair reads are exactly
+    d x damage."""
+    from chunky_bits_tpu.file.profiler import new_profiler
+
+    cluster = Cluster.from_obj(make_cluster_obj(
+        tmp_path, packed=False, chunk_log2=14,
+        tunables={"repair_block_bytes": 4096}))
+    payload = write_payload(cluster, "obj", 3 * (1 << 14), seed=11)
+
+    async def main():
+        ref = await cluster.get_file_ref("obj")
+        flip_byte(ref.parts[0].data[0].locations[0], 7000)
+        profiler, _reporter = new_profiler()
+        daemon = ScrubDaemon(cluster, bytes_per_sec=0,
+                             profiler=profiler)
+        stats = await daemon.run_once()
+        rs = stats.repair
+        assert rs["bytes_localized"] == 0, rs
+        assert rs["helper_bytes_decode"] == 3 * 4096, rs
+        got = await cluster.file_read_builder(
+            await cluster.get_file_ref("obj")).read_all()
+        assert got == payload
+
+    asyncio.run(main())
+
+
+def test_copy_plan_prefers_replica_over_decode(tmp_path):
+    """A corrupt replica BESIDE a healthy one: 1x ranged copy from the
+    replica (one 4 KiB block), never a d x decode."""
+    cluster = Cluster.from_obj(make_cluster_obj(
+        tmp_path, chunk_log2=14,
+        tunables={"repair_block_bytes": 4096}))
+    payload = write_payload(cluster, "obj", 3 * (1 << 14), seed=4)
+
+    async def main():
+        ref = await cluster.get_file_ref("obj")
+        chunk = ref.parts[0].data[0]
+        data = await chunk.locations[0].read()
+        victim_root = os.path.dirname(chunk.locations[0].target)
+        other = next(d for d in
+                     (os.path.join(str(tmp_path), f"disk{i}")
+                      for i in range(5))
+                     if d != victim_root)
+        replica = Location.parse(f"slab:{other}/{chunk.hash}")
+        await replica.write(bytes(data))
+        chunk.locations.append(replica)
+        await cluster.write_file_ref("obj", ref)
+        flip_byte(chunk.locations[0], 9000)
+        daemon = ScrubDaemon(cluster, bytes_per_sec=0)
+        stats = await daemon.run_once()
+        rs = stats.repair
+        assert rs["plans_copy"] == 1 and rs["plans_decode"] == 0
+        assert rs["helper_bytes_replica"] == 4096  # the damaged block
+        assert rs["helper_bytes_decode"] == 0
+        assert rs["bytes_rebuilt"] == 4096
+        got = await cluster.file_read_builder(
+            await cluster.get_file_ref("obj")).read_all()
+        assert got == payload
+
+    asyncio.run(main())
+
+
+def test_two_lost_chunks_rebuild_in_one_decode_plan(tmp_path):
+    """p chunks lost at once (the worst recoverable case): one decode
+    plan rebuilds both from the same ranged helper reads."""
+    cluster = Cluster.from_obj(make_cluster_obj(
+        tmp_path, chunk_log2=14,
+        tunables={"repair_block_bytes": 4096}))
+    payload = write_payload(cluster, "obj", 3 * (1 << 14), seed=5)
+
+    async def main():
+        ref = await cluster.get_file_ref("obj")
+        flip_byte(ref.parts[0].data[0].locations[0], 100)
+        flip_byte(ref.parts[0].parity[1].locations[0], 200)
+        daemon = ScrubDaemon(cluster, bytes_per_sec=0)
+        stats = await daemon.run_once()
+        rs = stats.repair
+        assert stats.corrupt == 2 and stats.repaired == 2
+        assert rs["plans_decode"] == 1
+        # both damaged blocks land in one range union read off d
+        # helpers: 3 x (0..4096) — both flips hit block 0
+        assert rs["helper_bytes_decode"] == 3 * 4096
+        got = await cluster.file_read_builder(
+            await cluster.get_file_ref("obj")).read_all()
+        assert got == payload
+        verify = await (await cluster.get_file_ref("obj")).verify(
+            cluster.tunables.location_context())
+        assert str(verify.integrity()) == "Valid"
+
+    asyncio.run(main())
+
+
+def test_unrecoverable_part_falls_back_and_counts_failure(tmp_path):
+    """More than p chunks lost: the planner hands the part back (one
+    fallback plan), the classic resilver reports the failure — the
+    legacy accounting, not a silent skip."""
+    cluster = Cluster.from_obj(make_cluster_obj(
+        tmp_path, chunk_log2=14,
+        tunables={"repair_block_bytes": 4096}))
+    write_payload(cluster, "obj", 3 * (1 << 14), seed=6)
+
+    async def main():
+        ref = await cluster.get_file_ref("obj")
+        for chunk in (ref.parts[0].data[0], ref.parts[0].data[1],
+                      ref.parts[0].parity[0]):
+            flip_byte(chunk.locations[0], 50)
+        daemon = ScrubDaemon(cluster, bytes_per_sec=0)
+        stats = await daemon.run_once()
+        assert stats.repair["plans_fallback"] >= 1
+        assert stats.repair_failures >= 1
+        assert stats.repaired == 0
+
+    asyncio.run(main())
+
+
+def test_chunk_with_no_locations_falls_back_to_resilver(tmp_path):
+    """A chunk stripped of every replica needs NEW placement — the
+    planner hands the part to the classic resilver (which allocates a
+    writer) instead of silently skipping it."""
+    cluster = Cluster.from_obj(make_cluster_obj(
+        tmp_path, chunk_log2=14,
+        tunables={"repair_block_bytes": 4096}))
+    payload = write_payload(cluster, "obj", 3 * (1 << 14), seed=8)
+
+    async def main():
+        ref = await cluster.get_file_ref("obj")
+        victim = ref.parts[0].data[2]
+        await victim.locations[0].delete()
+        victim.locations.clear()
+        await cluster.write_file_ref("obj", ref)
+        daemon = ScrubDaemon(cluster, bytes_per_sec=0)
+        stats = await daemon.run_once()
+        assert stats.repair["plans_fallback"] >= 1
+        assert stats.repaired >= 1  # resilver placed a new replica
+        ref2 = await cluster.get_file_ref("obj")
+        assert ref2.parts[0].data[2].locations, "no replica re-placed"
+        got = await cluster.file_read_builder(ref2).read_all()
+        assert got == payload
+        verify = await ref2.verify(cluster.tunables.location_context())
+        assert str(verify.integrity()) == "Valid"
+
+    asyncio.run(main())
+
+
+def test_old_refs_without_trees_repair_as_before(tmp_path):
+    """References written with the tunable OFF (every pre-existing
+    ref): no localization, whole-chunk plans, and the repaired file is
+    byte-identical — the compat direction of the acceptance criteria.
+    The tunable is pinned OFF in YAML (which wins) so the CI leg that
+    sets $CHUNKY_BITS_TPU_REPAIR_BLOCK_BYTES suite-wide still
+    exercises the tree-less path here."""
+    cluster = Cluster.from_obj(make_cluster_obj(
+        tmp_path, chunk_log2=14,
+        tunables={"repair_block_bytes": 0}))
+    payload = write_payload(cluster, "obj", 3 * (1 << 14), seed=7)
+
+    async def main():
+        ref = await cluster.get_file_ref("obj")
+        assert ref.parts[0].data[0].blocks is None
+        flip_byte(ref.parts[0].data[0].locations[0], 5000)
+        daemon = ScrubDaemon(cluster, bytes_per_sec=0)
+        stats = await daemon.run_once()
+        rs = stats.repair
+        assert stats.repaired == 1
+        assert rs["plans_decode"] == 1
+        # whole-chunk ranged reads: d x chunksize, no localization read
+        assert rs["helper_bytes_decode"] == 3 * (1 << 14)
+        assert rs["bytes_localized"] == 0
+        assert rs["bytes_rebuilt"] == 1 << 14
+        got = await cluster.file_read_builder(
+            await cluster.get_file_ref("obj")).read_all()
+        assert got == payload
+
+    asyncio.run(main())
+
+
+# ---- byte-identity fuzz: partial vs full vs oracle, all backends ----
+
+@pytest.mark.parametrize("backend", ["numpy", "native", "jax"])
+def test_partial_rebuild_byte_identity_fuzz(tmp_path, backend):
+    """Randomized damage repaired three ways — the planner's localized
+    ranged rebuild, the planner without trees (whole-chunk), and the
+    legacy full-part resilver — must all converge every replica to the
+    SAME bytes the numpy-oracle content hashes pin, on every backend."""
+    if backend == "native":
+        from chunky_bits_tpu.ops.backend import get_backend
+
+        try:
+            get_backend("native")
+        except Exception as err:  # pragma: no cover - missing g++
+            pytest.skip(f"native backend unavailable: {err}")
+
+    rng = np.random.default_rng(42)
+    legs = (("treed", True, True), ("untreed", False, True),
+            ("legacy", True, False))
+
+    async def run_leg(name, trees, planner):
+        # pinned in YAML either way (YAML wins over the CI leg's
+        # suite-wide $CHUNKY_BITS_TPU_REPAIR_BLOCK_BYTES)
+        tunables = {"backend": backend,
+                    "repair_block_bytes": 1024 if trees else 0}
+        cluster = Cluster.from_obj(make_cluster_obj(
+            tmp_path / f"{backend}-{name}", chunk_log2=12,
+            tunables=tunables))
+        payload = np.random.default_rng(9).integers(
+            0, 256, 3 * 4096 + 777, dtype=np.uint8).tobytes()
+        await cluster.write_file("obj", aio.BytesReader(payload),
+                                 cluster.get_profile())
+        ref = await cluster.get_file_ref("obj")
+        # identical damage pattern per leg: rng re-seeded per call
+        damage_rng = np.random.default_rng(1234)
+        for part in ref.parts:
+            chunks = part.data + part.parity
+            victims = damage_rng.choice(
+                len(chunks), size=2, replace=False)
+            for ci in victims:
+                offset = int(damage_rng.integers(0, part.chunksize))
+                flip_byte(chunks[ci].locations[0], offset)
+        daemon = ScrubDaemon(cluster, bytes_per_sec=0, planner=planner)
+        stats = await daemon.run_once()
+        assert stats.corrupt >= 2, (name, stats)
+        ref2 = await cluster.get_file_ref("obj")
+        verify = await ref2.verify(cluster.tunables.location_context())
+        assert str(verify.integrity()) == "Valid", (name, str(verify))
+        got = await cluster.file_read_builder(ref2).read_all()
+        assert got == payload, f"leg {name} not byte-identical"
+        # replica bytes equal the oracle content hash by construction
+        # (verify above re-hashed every replica); also pin the raw
+        # bytes across legs via the chunk digests
+        return sorted(str(c.hash) for p in ref2.parts
+                      for c in p.data + p.parity)
+
+    async def main():
+        results = [await run_leg(*leg) for leg in legs]
+        assert results[0] == results[1] == results[2]
+
+    asyncio.run(main())
+
+
+# ---- churn: scrub + planner converge under concurrent writes ----
+
+def test_scrub_planner_converges_under_churn(tmp_path):
+    """Localized corruption is repaired while a writer churns OTHER
+    objects and overwrites one mid-pass: the planner converges the
+    damage, never clobbers the concurrent overwrite, and every repair
+    byte stays metered."""
+    cluster = Cluster.from_obj(make_cluster_obj(
+        tmp_path, chunk_log2=14,
+        tunables={"repair_block_bytes": 4096}))
+    payloads = {
+        f"o{i}": write_payload(cluster, f"o{i}", 3 * (1 << 14), seed=i)
+        for i in range(4)
+    }
+
+    async def main():
+        for i in (0, 2):
+            ref = await cluster.get_file_ref(f"o{i}")
+            flip_byte(ref.parts[0].data[i % 3].locations[0], 6000 + i)
+
+        daemon = ScrubDaemon(cluster, bytes_per_sec=0,
+                             interval_seconds=0.01)
+        taken = meter_bucket(daemon)
+
+        async def churn():
+            # overwrite o3 and keep writing fresh objects while the
+            # scrub pass runs
+            for n in range(6):
+                data = np.random.default_rng(100 + n).integers(
+                    0, 256, 3 * (1 << 14), dtype=np.uint8).tobytes()
+                name = "o3" if n == 0 else f"churn{n}"
+                await cluster.write_file(
+                    name, aio.BytesReader(data),
+                    cluster.get_profile())
+                payloads[name] = data
+                await asyncio.sleep(0.01)
+
+        daemon.start()
+        await churn()
+        for _ in range(200):
+            stats = daemon.stats()
+            if stats.repaired >= 2 and stats.passes >= 1:
+                break
+            await asyncio.sleep(0.05)
+        await daemon.stop()
+        stats = daemon.stats()
+        assert stats.repaired >= 2, stats
+        rs = stats.repair
+        assert rs["plans_decode"] >= 2
+        # metered: the bucket saw at least every helper/localize/write
+        # byte the planner reports (verification rides the same bucket)
+        assert sum(taken) >= (rs["helper_bytes_decode"]
+                              + rs["helper_bytes_replica"]
+                              + rs["bytes_localized"]
+                              + rs["bytes_written"])
+        for name, payload in payloads.items():
+            ref = await cluster.get_file_ref(name)
+            got = await cluster.file_read_builder(ref).read_all()
+            assert got == payload, f"{name} diverged under churn"
+
+    asyncio.run(main())
