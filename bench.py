@@ -2044,6 +2044,177 @@ def bench_repair_bandwidth(argv=()) -> None:
         sys.exit(3)
 
 
+def bench_xor_schedule(argv=()) -> None:
+    """BASELINE.md config 12: scheduled-XOR erasure engine vs the
+    byte-table kernels (CPU-only, no tunnel, no gateway).
+
+    A chunk-size x geometry grid, encode AND decode-with-p-erasures
+    legs.  Each cell measures three engines on identical data, with
+    in-run byte-identity asserts between them:
+
+    * ``table``        — the current native path at its best runtime
+      tier (GFNI > AVX2 pshufb > scalar on this build+CPU): the A/B's
+      OFF leg and the headline ``speedup`` denominator;
+    * ``table_scalar`` — the same kernels forced to the scalar table
+      (``cb_gf_set_level(0)``): what a build/CPU without SIMD table
+      kernels runs — the deployment the XOR engine exists for;
+    * ``xor``          — the scheduled-XOR engine
+      (``CHUNKY_BITS_TPU_XOR_SCHEDULE`` path, ops/xor_schedule.py).
+
+    Flags: ``--sizes-kib 64,1024,4096`` / ``--geoms 3x2,10x4,20x6`` /
+    ``--iters 3`` (best-of) / ``--mib 64`` (per-cell working set) /
+    ``--smoke`` (one 64 KiB d=3 p=2 cell, seconds-scale — the CI
+    step).  One JSON line always; failures exit 3 with the same
+    contract as configs 8-11.  ``value`` is the best cell's speedup of
+    xor over the CURRENT native path — the keep-the-winner rule: the
+    flag stays opt-in unless this exceeds 1.0 on the deployment's own
+    grid."""
+    import time as _time
+
+    metric = "cpu_xor_schedule_vs_native_speedup"
+    try:
+        from chunky_bits_tpu.ops import matrix, xor_schedule
+        from chunky_bits_tpu.ops.cpu_backend import (NativeBackend,
+                                                     gf_force_level)
+
+        def flag(name, default, cast):
+            argv_l = list(argv)
+            if name in argv_l:
+                return cast(argv_l[argv_l.index(name) + 1])
+            return default
+
+        smoke = "--smoke" in argv
+        sizes = flag("--sizes-kib", "64" if smoke else "64,1024,4096",
+                     str)
+        geoms = flag("--geoms", "3x2" if smoke else "3x2,10x4,20x6",
+                     str)
+        iters = flag("--iters", 1 if smoke else 3, int)
+        mib = flag("--mib", 8 if smoke else 64, int)
+        size_list = [int(x) << 10 for x in sizes.split(",")]
+        geom_list = []
+        for g in geoms.split(","):
+            d_s, p_s = g.lower().split("x")
+            geom_list.append((int(d_s), int(p_s)))
+        if iters < 1 or mib < 1 or not size_list or not geom_list:
+            raise ValueError("need --iters >= 1, --mib >= 1 and "
+                             "non-empty --sizes-kib/--geoms")
+        for s in size_list:
+            if s % 8 or s < 8:
+                raise ValueError(f"--sizes-kib entries must be "
+                                 f"multiples of 8 bytes, got {s}")
+        for d, p in geom_list:
+            if d < 1 or p < 1:
+                raise ValueError(f"bad geometry d={d} p={p}")
+
+        rng = np.random.default_rng(0)
+        table = NativeBackend(xor_schedule=False)
+        xor = NativeBackend(xor_schedule=True)
+
+        def best_s(apply_fn):
+            best = float("inf")
+            for _ in range(iters):
+                t0 = _time.perf_counter()
+                apply_fn()
+                best = min(best, _time.perf_counter() - t0)
+            return best
+
+        grid = []
+        sched_meta = {}
+        for d, p in geom_list:
+            enc = matrix.build_encode_matrix(d, p)
+            t0 = _time.perf_counter()
+            sched = xor_schedule.get_schedule(enc[d:])
+            sched_meta[f"{d}x{p}"] = {
+                "build_ms": round((_time.perf_counter() - t0) * 1e3, 1),
+                "raw_xors": sched.raw_xors,
+                "ops": int(sched.ops.shape[0]),
+                "planes": sched.n_planes,
+            }
+            for size in size_list:
+                batch = max(1, (mib << 20) // (d * size))
+                data = rng.integers(0, 256, (batch, d, size),
+                                    dtype=np.uint8)
+                nbytes = batch * d * size
+                for leg in ("encode", "decode"):
+                    if leg == "encode":
+                        mat = enc[d:]
+                        src = data
+                    else:
+                        parity = table.apply_matrix(enc[d:], data)
+                        full = np.concatenate([data, parity], axis=1)
+                        erased = sorted(
+                            rng.choice(d + p, size=p,
+                                       replace=False).tolist())
+                        present = [i for i in range(d + p)
+                                   if i not in erased]
+                        mat = matrix.decode_matrix(enc, present, erased)
+                        src = np.ascontiguousarray(
+                            full[:, np.array(present[:d]), :])
+                    # identity between the engines on this cell's data
+                    want = table.apply_matrix(mat, src)
+                    got = xor.apply_matrix(mat, src)
+                    if not np.array_equal(want, got):
+                        raise RuntimeError(
+                            f"byte identity broke at d={d} p={p} "
+                            f"size={size} {leg}")
+                    del want, got
+                    t_best = best_s(lambda: table.apply_matrix(mat, src))
+                    gf_force_level(0)
+                    try:
+                        t_scalar = best_s(
+                            lambda: table.apply_matrix(mat, src))
+                    finally:
+                        gf_force_level(2)
+                    x_best = best_s(lambda: xor.apply_matrix(mat, src))
+                    cell = {
+                        "size_kib": size >> 10, "d": d, "p": p,
+                        "leg": leg,
+                        "table_gibps": round(
+                            nbytes / t_best / (1 << 30), 2),
+                        "table_scalar_gibps": round(
+                            nbytes / t_scalar / (1 << 30), 2),
+                        "xor_gibps": round(
+                            nbytes / x_best / (1 << 30), 2),
+                        "speedup": round(t_best / x_best, 2),
+                        "speedup_vs_scalar": round(
+                            t_scalar / x_best, 2),
+                    }
+                    grid.append(cell)
+                    print(f"# config 12: d{d}p{p} {size >> 10}KiB "
+                          f"{leg}: table {cell['table_gibps']} "
+                          f"(scalar {cell['table_scalar_gibps']}) vs "
+                          f"xor {cell['xor_gibps']} GiB/s -> "
+                          f"{cell['speedup']}x "
+                          f"(vs scalar {cell['speedup_vs_scalar']}x)",
+                          file=sys.stderr)
+        best_cell = max(grid, key=lambda c: c["speedup"])
+        wins = sum(1 for c in grid if c["speedup"] > 1.0)
+        wins_scalar = sum(1 for c in grid
+                          if c["speedup_vs_scalar"] > 1.0)
+        print(json.dumps({
+            "metric": metric,
+            # the keep-the-winner gate: > 1.0 anywhere on the grid is
+            # the only thing that would justify defaulting the flag on
+            "value": best_cell["speedup"], "unit": "x",
+            "vs_baseline": best_cell["speedup"],
+            "wins": wins, "cells": len(grid),
+            "wins_vs_scalar": wins_scalar,
+            "best_cell": {k: best_cell[k]
+                          for k in ("size_kib", "d", "p", "leg")},
+            "schedules": sched_meta,
+            "grid": grid,
+        }))
+    # lint: broad-except-ok the driver contract (ONE parseable JSON
+    # line, always) outranks the traceback; the error text carries it
+    except Exception as err:
+        print(json.dumps({
+            "metric": metric, "value": 0.0, "unit": "x",
+            "vs_baseline": 0.0,
+            "error": f"{type(err).__name__}: {err}",
+        }))
+        sys.exit(3)
+
+
 if __name__ == "__main__":
     # Bench measures the product defaults: the runtime concurrency
     # sanitizer (analysis/sanitizer.py) must stay OFF here even when an
@@ -2066,18 +2237,21 @@ if __name__ == "__main__":
                    "8": lambda: bench_hedged_read(sys.argv),
                    "9": lambda: bench_gateway_scaleout(sys.argv),
                    "10": lambda: bench_slab_store(sys.argv),
-                   "11": lambda: bench_repair_bandwidth(sys.argv)}
+                   "11": lambda: bench_repair_bandwidth(sys.argv),
+                   "12": lambda: bench_xor_schedule(sys.argv)}
         idx = sys.argv.index("--config") + 1
         which = sys.argv[idx] if idx < len(sys.argv) else ""
         if which not in configs:
-            print(f"usage: bench.py [--config {{1,2,3,4,6,7,8,9,10,11}}]"
+            print(f"usage: bench.py [--config "
+                  f"{{1,2,3,4,6,7,8,9,10,11,12}}]"
                   f" — the device kernel metric (configs 2+3's compute "
                   f"core) is the default no-arg run (got {which!r}); 6 "
                   f"is the hot-read cache A/B, 7 the gateway PUT ingest "
                   f"A/B, 8 the hedged-read tail-latency A/B, 9 the "
                   f"gateway scale-out multi-worker A/B, 10 the packed "
                   f"slab store vs file-per-chunk A/B, 11 the "
-                  f"repair-bandwidth planner A/B (all CPU-only)",
+                  f"repair-bandwidth planner A/B, 12 the scheduled-XOR "
+                  f"erasure engine vs byte-table grid (all CPU-only)",
                   file=sys.stderr)
             sys.exit(2)
         configs[which]()

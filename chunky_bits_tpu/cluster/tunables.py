@@ -119,6 +119,18 @@ REPAIR_BLOCK_BYTES_ENV = "CHUNKY_BITS_TPU_REPAIR_BLOCK_BYTES"
 #: var supplies the default.  Read at gateway app build.
 TRACE_SLOW_MS_ENV = "CHUNKY_BITS_TPU_TRACE_SLOW_MS"
 
+#: scheduled-XOR erasure engine for the CPU plane (ops/xor_schedule.py
+#: + native/gf256.cpp `cb_xor_exec`): lower the GF(2^8) coding matrix
+#: to a CSE'd pure-XOR program over bit-planes and execute it with
+#: runtime-dispatched wide XORs instead of per-byte table lookups.
+#: Byte-identical output either way (conformance fuzz + golden pin
+#: it).  Off by default per the measure-before-defaulting invariant —
+#: bench --config 12 is the A/B grid; on GFNI hosts the table path
+#: wins, the XOR engine's domain is hosts/builds without SIMD table
+#: kernels.  Read at first dispatch of each NativeBackend instance
+#: (like every routing flag: set it before the first encode).
+XOR_SCHEDULE_ENV = "CHUNKY_BITS_TPU_XOR_SCHEDULE"
+
 #: opt-in runtime concurrency sanitizer (analysis/sanitizer.py):
 #: event-loop stall watchdog, task-leak registry, host-pipeline handoff
 #: checks.  Off by default (and force-disabled by bench.py — the
@@ -202,6 +214,16 @@ def sanitize_enabled() -> bool:
     even loads the instrumentation module (pinned by
     tests/test_sanitizer.py's zero-overhead check)."""
     return env_flag(SANITIZE_ENV)
+
+
+def xor_schedule_enabled(*, default: bool = False) -> bool:
+    """True when ``$CHUNKY_BITS_TPU_XOR_SCHEDULE`` asks the native
+    erasure backend to run GF(2^8) matrix applies as scheduled wide
+    XORs over bit-planes (ops/xor_schedule.py) instead of per-byte
+    table kernels.  Output is byte-identical either way; the knob only
+    moves compute between engines, so it parses as a standard flag and
+    is read at first dispatch (baked per backend instance)."""
+    return env_flag(XOR_SCHEDULE_ENV, default=default)
 
 
 def gateway_workers(*, default: int = 1) -> int:

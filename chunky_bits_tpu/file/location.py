@@ -607,11 +607,18 @@ class Location:
                     raise FileNotFoundError(
                         f"no live chunk {name!r} in slab store {root}")
                 f = open(store.slab_path(ext.slab), "rb")
-                f.seek(ext.offset + rng.start)
+                try:
+                    f.seek(ext.offset + rng.start)
+                except BaseException:
+                    f.close()
+                    raise
                 return f, ext
 
             try:
-                f, ext = await asyncio.to_thread(_open)
+                # cancel-safe hop: a scrub restart or hedge loser
+                # cancelled mid-open must not orphan the slab handle
+                f, ext = await aio.open_in_thread(
+                    _open, lambda r: r[0].close())
             except OSError as err:
                 raise LocationError(str(err)) from err
             base = aio.FileReader(store.slab_path(ext.slab), fileobj=f)
@@ -624,10 +631,19 @@ class Location:
                     rng.length)
             return aio.TakeReader(base, min(rng.length, avail))
         if self.is_local():
+            def _open_local():
+                f = open(self.target, "rb")
+                try:
+                    if rng.start:
+                        f.seek(rng.start)
+                except BaseException:
+                    f.close()
+                    raise
+                return f
+
             try:
-                f = await asyncio.to_thread(open, self.target, "rb")
-                if rng.start:
-                    await asyncio.to_thread(f.seek, rng.start)
+                f = await aio.open_in_thread(
+                    _open_local, lambda h: h.close())
             except OSError as err:
                 raise LocationError(str(err)) from err
             base = aio.FileReader(self.target, fileobj=f)

@@ -156,6 +156,24 @@ size_t mul_row_xor_gfni(uint8_t, const uint8_t*, uint8_t*, size_t) {
 }
 #endif
 
+// ---- runtime GF table-tier selection ----
+//
+// The byte-level table kernels come in three tiers: GFNI (2), AVX2
+// nibble-pshufb (1, only when the build compiled AVX2 in), scalar
+// full-table (0).  The active tier is runtime-selectable so bench
+// --config 12 can A/B the scheduled-XOR engine against every tier a
+// deployment might actually run (a generic -O3 fallback build has no
+// pshufb path at all), and tests can pin the scalar path.
+
+#ifdef __AVX2__
+constexpr int kGfCompiledSimd = 1;
+#else
+constexpr int kGfCompiledSimd = 0;
+#endif
+
+int g_gf_best = kGfni ? 2 : kGfCompiledSimd;
+int g_gf_level = g_gf_best;
+
 void xor_row(const uint8_t* src, uint8_t* dst, size_t n) {
     size_t i = 0;
 #ifdef __AVX2__
@@ -174,12 +192,16 @@ void xor_row(const uint8_t* src, uint8_t* dst, size_t n) {
 void mul_row_xor(uint8_t c, const uint8_t* src, uint8_t* dst, size_t n) {
     const uint8_t* table = MUL[c];
     size_t i = 0;
-    if (kGfni) {
+    if (g_gf_level >= 2) {
         i = mul_row_xor_gfni(c, src, dst, n);
         for (; i < n; i++) dst[i] ^= table[src[i]];
         return;
     }
 #ifdef __AVX2__
+    if (g_gf_level < 1) {
+        for (; i < n; i++) dst[i] ^= table[src[i]];
+        return;
+    }
     alignas(16) uint8_t lo[16], hi[16];
     for (int v = 0; v < 16; v++) {
         lo[v] = MUL[c][v];
@@ -653,6 +675,326 @@ void parallel_for(size_t n, int nthreads, Fn fn) {
     for (auto& th : pool) th.join();
 }
 
+// ---- scheduled-XOR engine (ops/xor_schedule.py) ----
+//
+// Executes a pre-compiled XOR program over bit-planes: shards are
+// transposed into 8 planes each (plane v, byte t8, bit b = bit v of
+// shard byte 8*t8+b), the flat (dst, src, kind) op list runs as
+// plane-wide XOR/copy/zero over an arena tiled to stay L1/L2-resident
+// (arXiv:2108.02692's cache-tiling), and output planes transpose back
+// to parity bytes — so every emitted byte is identical to the table
+// codecs (the content-address invariant), while the per-byte k*r
+// table work becomes wide XORs.
+//
+// Runtime dispatch discipline matches the SHA-NI/GFNI fixes above: no
+// reliance on -march (the generic fallback build must still get SIMD
+// here), raw-CPUID feature detection, AVX2 bodies behind a target
+// attribute, SSE2 as the x86_64 baseline, portable scalar elsewhere —
+// and the active level is forcible (cb_xor_set_impl) so the scalar
+// fallback is pinned by a test, not trusted.
+namespace xorsched {
+
+// 8x8 bit-matrix transpose of a uint64 (byte i = row i, bit j = col
+// j): the standard three delta-swaps; an involution, so it serves
+// both directions (bytes -> planes and planes -> bytes).
+inline uint64_t transpose8(uint64_t x) {
+    uint64_t t;
+    t = (x ^ (x >> 7)) & 0x00AA00AA00AA00AAULL;
+    x = x ^ t ^ (t << 7);
+    t = (x ^ (x >> 14)) & 0x0000CCCC0000CCCCULL;
+    x = x ^ t ^ (t << 14);
+    t = (x ^ (x >> 28)) & 0x00000000F0F0F0F0ULL;
+    x = x ^ t ^ (t << 28);
+    return x;
+}
+
+#if defined(__x86_64__) && defined(__GNUC__)
+#define CB_XOR_X86 1
+#include <cpuid.h>
+
+// Raw-CPUID AVX2 detection (leaf 7 EBX bit 5) plus the OS half the
+// feature bit alone doesn't prove: OSXSAVE (leaf 1 ECX bit 27) and
+// XCR0 xmm+ymm state via xgetbv — an AVX2 CPU under an OS that never
+// enables ymm state would fault on the first vector op.
+bool cpu_has_avx2() {
+    unsigned int eax = 0, ebx = 0, ecx = 0, edx = 0;
+    if (!__get_cpuid_count(7, 0, &eax, &ebx, &ecx, &edx)) return false;
+    if (!((ebx >> 5) & 1u)) return false;
+    if (!__get_cpuid(1, &eax, &ebx, &ecx, &edx)) return false;
+    if (!((ecx >> 27) & 1u)) return false;  // OSXSAVE
+    unsigned int lo = 0, hi = 0;
+    __asm__ volatile("xgetbv" : "=a"(lo), "=d"(hi) : "c"(0));
+    return (lo & 0x6) == 0x6;  // XMM + YMM state enabled
+}
+
+// two-lane transpose8 (SSE2 shifts are per-64-bit-lane already)
+inline __m128i transpose8_x2(__m128i x) {
+    const __m128i mA = _mm_set1_epi64x(0x00AA00AA00AA00AALL);
+    const __m128i mC = _mm_set1_epi64x(0x0000CCCC0000CCCCLL);
+    const __m128i mF = _mm_set1_epi64x(0x00000000F0F0F0F0LL);
+    __m128i t;
+    t = _mm_and_si128(_mm_xor_si128(x, _mm_srli_epi64(x, 7)), mA);
+    x = _mm_xor_si128(x, _mm_xor_si128(t, _mm_slli_epi64(t, 7)));
+    t = _mm_and_si128(_mm_xor_si128(x, _mm_srli_epi64(x, 14)), mC);
+    x = _mm_xor_si128(x, _mm_xor_si128(t, _mm_slli_epi64(t, 14)));
+    t = _mm_and_si128(_mm_xor_si128(x, _mm_srli_epi64(x, 28)), mF);
+    x = _mm_xor_si128(x, _mm_xor_si128(t, _mm_slli_epi64(t, 28)));
+    return x;
+}
+#endif
+
+int detect_best() {
+#ifdef CB_XOR_X86
+    return cpu_has_avx2() ? 2 : 1;  // SSE2 is the x86_64 baseline
+#else
+    return 0;
+#endif
+}
+
+const int kXorBest = detect_best();
+int g_xor_level = kXorBest;  // 0 scalar / 1 sse2 / 2 avx2
+
+// -- split: 8*tl shard bytes -> 8 planes of tl bytes (p0 + v*stride) --
+
+void split_scalar(const uint8_t* src, size_t tl, uint8_t* p0,
+                  size_t stride) {
+    for (size_t t = 0; t < tl; t++) {
+        uint64_t w;
+        std::memcpy(&w, src + 8 * t, 8);
+        w = transpose8(w);
+        for (int v = 0; v < 8; v++)
+            p0[v * stride + t] = static_cast<uint8_t>(w >> (8 * v));
+    }
+}
+
+#ifdef CB_XOR_X86
+// movemask reads bit 7 of each byte; add_epi8(x, x) shifts each byte
+// left one bit with no cross-byte traffic, so eight mask+shift rounds
+// peel plane 7 down to plane 0 — 2 plane bytes per 16 source bytes.
+void split_sse2(const uint8_t* src, size_t tl, uint8_t* p0,
+                size_t stride) {
+    size_t t = 0;
+    for (; t + 2 <= tl; t += 2) {
+        __m128i x = _mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(src + 8 * t));
+        for (int v = 7; v >= 0; v--) {
+            uint16_t m = static_cast<uint16_t>(_mm_movemask_epi8(x));
+            std::memcpy(p0 + v * stride + t, &m, 2);
+            x = _mm_add_epi8(x, x);
+        }
+    }
+    if (t < tl) split_scalar(src + 8 * t, tl - t, p0 + t, stride);
+}
+
+__attribute__((target("avx2")))
+void split_avx2(const uint8_t* src, size_t tl, uint8_t* p0,
+                size_t stride) {
+    size_t t = 0;
+    for (; t + 4 <= tl; t += 4) {
+        __m256i x = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i*>(src + 8 * t));
+        for (int v = 7; v >= 0; v--) {
+            uint32_t m = static_cast<uint32_t>(_mm256_movemask_epi8(x));
+            std::memcpy(p0 + v * stride + t, &m, 4);
+            x = _mm256_add_epi8(x, x);
+        }
+    }
+    if (t < tl) split_sse2(src + 8 * t, tl - t, p0 + t, stride);
+}
+#endif
+
+// -- pack: 8 planes of tl bytes -> 8*tl output bytes --
+
+void pack_scalar(const uint8_t* p0, size_t stride, size_t tl,
+                 uint8_t* dst) {
+    for (size_t t = 0; t < tl; t++) {
+        uint64_t w = 0;
+        for (int v = 0; v < 8; v++)
+            w |= static_cast<uint64_t>(p0[v * stride + t]) << (8 * v);
+        w = transpose8(w);
+        std::memcpy(dst + 8 * t, &w, 8);
+    }
+}
+
+#ifdef CB_XOR_X86
+// 16 plane-byte columns at a time: a 3-level punpck tower turns the 8
+// plane rows into 16 byte-groups [p0[u]..p7[u]], each transposed as a
+// 64-bit lane pair — SSE2-baseline, so even the no-AVX2 build packs
+// at vector speed.
+void pack_sse2(const uint8_t* p0, size_t stride, size_t tl,
+               uint8_t* dst) {
+    size_t t = 0;
+    for (; t + 16 <= tl; t += 16) {
+        __m128i x0 = _mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(p0 + 0 * stride + t));
+        __m128i x1 = _mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(p0 + 1 * stride + t));
+        __m128i x2 = _mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(p0 + 2 * stride + t));
+        __m128i x3 = _mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(p0 + 3 * stride + t));
+        __m128i x4 = _mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(p0 + 4 * stride + t));
+        __m128i x5 = _mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(p0 + 5 * stride + t));
+        __m128i x6 = _mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(p0 + 6 * stride + t));
+        __m128i x7 = _mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(p0 + 7 * stride + t));
+        __m128i a0 = _mm_unpacklo_epi8(x0, x1);
+        __m128i a1 = _mm_unpackhi_epi8(x0, x1);
+        __m128i b0 = _mm_unpacklo_epi8(x2, x3);
+        __m128i b1 = _mm_unpackhi_epi8(x2, x3);
+        __m128i c0 = _mm_unpacklo_epi8(x4, x5);
+        __m128i c1 = _mm_unpackhi_epi8(x4, x5);
+        __m128i d0 = _mm_unpacklo_epi8(x6, x7);
+        __m128i d1 = _mm_unpackhi_epi8(x6, x7);
+        __m128i e0 = _mm_unpacklo_epi16(a0, b0);
+        __m128i e1 = _mm_unpackhi_epi16(a0, b0);
+        __m128i e2 = _mm_unpacklo_epi16(a1, b1);
+        __m128i e3 = _mm_unpackhi_epi16(a1, b1);
+        __m128i f0 = _mm_unpacklo_epi16(c0, d0);
+        __m128i f1 = _mm_unpackhi_epi16(c0, d0);
+        __m128i f2 = _mm_unpacklo_epi16(c1, d1);
+        __m128i f3 = _mm_unpackhi_epi16(c1, d1);
+        uint8_t* o = dst + 8 * t;
+        __m128i g;
+        g = transpose8_x2(_mm_unpacklo_epi32(e0, f0));
+        _mm_storeu_si128(reinterpret_cast<__m128i*>(o + 0), g);
+        g = transpose8_x2(_mm_unpackhi_epi32(e0, f0));
+        _mm_storeu_si128(reinterpret_cast<__m128i*>(o + 16), g);
+        g = transpose8_x2(_mm_unpacklo_epi32(e1, f1));
+        _mm_storeu_si128(reinterpret_cast<__m128i*>(o + 32), g);
+        g = transpose8_x2(_mm_unpackhi_epi32(e1, f1));
+        _mm_storeu_si128(reinterpret_cast<__m128i*>(o + 48), g);
+        g = transpose8_x2(_mm_unpacklo_epi32(e2, f2));
+        _mm_storeu_si128(reinterpret_cast<__m128i*>(o + 64), g);
+        g = transpose8_x2(_mm_unpackhi_epi32(e2, f2));
+        _mm_storeu_si128(reinterpret_cast<__m128i*>(o + 80), g);
+        g = transpose8_x2(_mm_unpacklo_epi32(e3, f3));
+        _mm_storeu_si128(reinterpret_cast<__m128i*>(o + 96), g);
+        g = transpose8_x2(_mm_unpackhi_epi32(e3, f3));
+        _mm_storeu_si128(reinterpret_cast<__m128i*>(o + 112), g);
+    }
+    if (t < tl) pack_scalar(p0 + t, stride, tl - t, dst + 8 * t);
+}
+#endif
+
+// -- the wide-XOR inner loop (the op list's hot kernel) --
+
+void xor_planes_scalar(uint8_t* dst, const uint8_t* src, size_t n) {
+    size_t i = 0;
+    for (; i + 8 <= n; i += 8) {
+        uint64_t a, b;
+        std::memcpy(&a, dst + i, 8);
+        std::memcpy(&b, src + i, 8);
+        a ^= b;
+        std::memcpy(dst + i, &a, 8);
+    }
+    for (; i < n; i++) dst[i] ^= src[i];
+}
+
+#ifdef CB_XOR_X86
+void xor_planes_sse2(uint8_t* dst, const uint8_t* src, size_t n) {
+    size_t i = 0;
+    for (; i + 16 <= n; i += 16) {
+        __m128i a = _mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(dst + i));
+        __m128i b = _mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(src + i));
+        _mm_storeu_si128(reinterpret_cast<__m128i*>(dst + i),
+                         _mm_xor_si128(a, b));
+    }
+    for (; i < n; i++) dst[i] ^= src[i];
+}
+
+__attribute__((target("avx2")))
+void xor_planes_avx2(uint8_t* dst, const uint8_t* src, size_t n) {
+    size_t i = 0;
+    for (; i + 32 <= n; i += 32) {
+        __m256i a = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i*>(dst + i));
+        __m256i b = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i*>(src + i));
+        _mm256_storeu_si256(reinterpret_cast<__m256i*>(dst + i),
+                            _mm256_xor_si256(a, b));
+    }
+    for (; i < n; i++) dst[i] ^= src[i];
+}
+#endif
+
+void split(const uint8_t* src, size_t tl, uint8_t* p0, size_t stride) {
+#ifdef CB_XOR_X86
+    if (g_xor_level >= 2) return split_avx2(src, tl, p0, stride);
+    if (g_xor_level >= 1) return split_sse2(src, tl, p0, stride);
+#endif
+    split_scalar(src, tl, p0, stride);
+}
+
+void pack(const uint8_t* p0, size_t stride, size_t tl, uint8_t* dst) {
+#ifdef CB_XOR_X86
+    if (g_xor_level >= 1) return pack_sse2(p0, stride, tl, dst);
+#endif
+    pack_scalar(p0, stride, tl, dst);
+}
+
+void xor_planes(uint8_t* dst, const uint8_t* src, size_t n) {
+#ifdef CB_XOR_X86
+    if (g_xor_level >= 2) return xor_planes_avx2(dst, src, n);
+    if (g_xor_level >= 1) return xor_planes_sse2(dst, src, n);
+#endif
+    xor_planes_scalar(dst, src, n);
+}
+
+//: arena budget: n_planes * tile bytes; 256 KiB keeps the whole
+//: working set L2-resident on everything this targets while leaving
+//: room for the source/dest streams
+constexpr size_t kXorArenaBytes = 256u << 10;
+
+// One batch item: run the whole op list per tile so every plane's
+// tile stays cache-hot across the program (the paper's L1-residency
+// reordering, realized as an outer tile loop).
+void xor_exec_one(const int32_t* ops, size_t n_ops, size_t n_planes,
+                  size_t k, size_t r, const uint8_t* item, size_t s,
+                  uint8_t* out) {
+    const size_t P = s / 8;
+    size_t tile = P;
+    if (n_planes * tile > kXorArenaBytes) {
+        tile = kXorArenaBytes / n_planes;
+        tile &= ~static_cast<size_t>(15);
+        if (tile == 0) tile = 16;
+    }
+    std::vector<uint8_t> arena(n_planes * tile);
+    uint8_t* A = arena.data();
+    const size_t out_base = n_planes - 8 * r;
+    for (size_t lo = 0; lo < P; lo += tile) {
+        const size_t tl = P - lo < tile ? P - lo : tile;
+        for (size_t j = 0; j < k; j++)
+            split(item + j * s + 8 * lo, tl, A + (8 * j) * tile, tile);
+        for (size_t o = 0; o < n_ops; o++) {
+            const int32_t dst = ops[3 * o];
+            const int32_t src = ops[3 * o + 1];
+            const int32_t kind = ops[3 * o + 2];
+            uint8_t* d = A + static_cast<size_t>(dst) * tile;
+            if (kind == 1) {
+                xor_planes(d, A + static_cast<size_t>(src) * tile, tl);
+            } else if (kind == 0) {
+                // slot recycling may hand a copy's dst the arena slot
+                // its src freed on this very op — already in place
+                const uint8_t* sp = A + static_cast<size_t>(src) * tile;
+                if (d != sp) std::memcpy(d, sp, tl);
+            } else {
+                std::memset(d, 0, tl);
+            }
+        }
+        for (size_t i = 0; i < r; i++)
+            pack(A + (out_base + 8 * i) * tile, tile, tl,
+                 out + i * s + 8 * lo);
+    }
+}
+
+}  // namespace xorsched
+
 }  // namespace
 
 extern "C" {
@@ -669,6 +1011,51 @@ void cb_apply_matrix(const uint8_t* mat, size_t r, size_t k,
 
 // Table self-check hook: lets Python assert C++ and numpy agree on the field.
 uint8_t cb_gf_mul(uint8_t a, uint8_t b) { return MUL[a][b]; }
+
+// Force the byte-table kernel tier (0 scalar table / 1 AVX2 pshufb /
+// 2 GFNI); clamped to what this build+CPU actually has.  Returns the
+// effective tier.  Bench --config 12 uses this to A/B the XOR engine
+// against every tier a deployment might run; output bytes are
+// identical at every tier (the tiers are the same math).
+int cb_gf_set_level(int level) {
+    if (level > g_gf_best) level = g_gf_best;
+    if (level == 1 && !kGfCompiledSimd) level = 0;
+    if (level < 0) level = 0;
+    g_gf_level = level;
+    return level;
+}
+
+int cb_gf_get_level(void) { return g_gf_level; }
+
+// Scheduled-XOR executor (ops/xor_schedule.py): run the compiled
+// (dst, src, kind) op list over bit-planes of every batch item.
+//   ops[n_ops, 3] int32 over arena ids [inputs 8k | temps | outputs 8r]
+//   out[b, r, s] = the schedule's matrix (x) shards[b, k, s]
+// s must be a multiple of 8 (the Python gate guarantees it); batch
+// items fan across std::threads like cb_apply_matrix, so a HostPipeline
+// slice calling with nthreads=1 keeps total host parallelism at the
+// scheduler's worker count.
+void cb_xor_exec(const int32_t* ops, size_t n_ops, size_t n_planes,
+                 size_t k, size_t r, const uint8_t* shards, size_t b,
+                 size_t s, uint8_t* out, int nthreads) {
+    if (!kInited || b == 0 || r == 0 || s == 0 || (s % 8) != 0) return;
+    parallel_for(b, nthreads, [=](size_t i) {
+        xorsched::xor_exec_one(ops, n_ops, n_planes, k, r,
+                               shards + i * k * s, s, out + i * r * s);
+    });
+}
+
+// Force the XOR engine's kernel tier (0 scalar / 1 SSE2 / 2 AVX2);
+// clamped to the detected ceiling.  Returns the effective tier — the
+// forced-scalar identity test pins the fallback path with this.
+int cb_xor_set_impl(int level) {
+    if (level > xorsched::kXorBest) level = xorsched::kXorBest;
+    if (level < 0) level = 0;
+    xorsched::g_xor_level = level;
+    return level;
+}
+
+int cb_xor_get_impl(void) { return xorsched::g_xor_level; }
 
 // SHA-256 of one buffer (SHA-NI when available).
 void cb_sha256(const uint8_t* data, size_t len, uint8_t* out) {

@@ -103,6 +103,21 @@ def _load():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
         ]
         lib.cb_encode_hash.restype = None
+        lib.cb_xor_exec.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.cb_xor_exec.restype = None
+        lib.cb_xor_set_impl.argtypes = [ctypes.c_int]
+        lib.cb_xor_set_impl.restype = ctypes.c_int
+        lib.cb_xor_get_impl.argtypes = []
+        lib.cb_xor_get_impl.restype = ctypes.c_int
+        lib.cb_gf_set_level.argtypes = [ctypes.c_int]
+        lib.cb_gf_set_level.restype = ctypes.c_int
+        lib.cb_gf_get_level.argtypes = []
+        lib.cb_gf_get_level.restype = ctypes.c_int
         # Field self-check: C++ tables must agree with the Python tables.
         for a, b in ((2, 0x80), (3, 7), (255, 255), (29, 1)):
             if lib.cb_gf_mul(a, b) != gf256.gf_mul(a, b):
@@ -128,6 +143,23 @@ def sha256_buf(data) -> bytes:
 
 def sha256_is_accelerated() -> bool:
     return bool(_load().cb_sha256_is_accelerated())
+
+
+def xor_force_impl(level: int) -> int:
+    """Force the scheduled-XOR engine's kernel tier (0 scalar / 1 SSE2
+    / 2 AVX2); clamped to the runtime-detected ceiling, returns the
+    effective tier.  Process-wide — tests pin the scalar fallback with
+    this, bench --config 12 sweeps it."""
+    return int(_load().cb_xor_set_impl(int(level)))
+
+
+def gf_force_level(level: int) -> int:
+    """Force the byte-table kernel tier (0 scalar table / 1 AVX2
+    pshufb / 2 GFNI); clamped to what this build+CPU has, returns the
+    effective tier.  Output bytes are identical at every tier — the
+    knob exists so bench --config 12 can measure the XOR engine
+    against each table tier a deployment might run."""
+    return int(_load().cb_gf_set_level(int(level)))
 
 
 _ALL = 0xFFFFFFFFFFFFFFFF
@@ -174,13 +206,50 @@ def sha256_rows(rows: np.ndarray, out: np.ndarray,
 
 
 class NativeBackend(ErasureBackend):
-    """ctypes binding over the C++ codec; thread-parallel across the batch."""
+    """ctypes binding over the C++ codec; thread-parallel across the batch.
+
+    ``xor_schedule`` selects the scheduled-XOR engine
+    (ops/xor_schedule.py + ``cb_xor_exec``) for matrix applies instead
+    of the byte-table kernels: ``None`` resolves
+    ``tunables.xor_schedule_enabled`` at first dispatch (the flag
+    contract — set the env var before the first encode), an explicit
+    bool pins it for this instance (tests and bench A/B both legs in
+    one process without env games).  Output is byte-identical either
+    way; shard lengths that are not a multiple of 8 fall back to the
+    table path per call.
+    """
 
     name = "native"
 
-    def __init__(self, nthreads: int = 0):
+    def __init__(self, nthreads: int = 0,
+                 xor_schedule: Optional[bool] = None):
         self.nthreads = nthreads
         self._lib = _load()
+        self._xor = xor_schedule
+
+    def _xor_enabled(self) -> bool:
+        if self._xor is None:
+            from chunky_bits_tpu.cluster.tunables import (
+                xor_schedule_enabled,
+            )
+
+            self._xor = xor_schedule_enabled()
+        return self._xor
+
+    def _xor_apply(self, mat: np.ndarray, shards: np.ndarray,
+                   out: np.ndarray, nthreads: int) -> None:
+        """Run one batched matrix apply through the scheduled-XOR
+        engine (caller guarantees s % 8 == 0, r >= 1, contiguity)."""
+        from chunky_bits_tpu.ops import xor_schedule
+
+        sched = xor_schedule.get_schedule(mat)
+        b, _k, s = shards.shape
+        self._lib.cb_xor_exec(
+            sched.ops.ctypes.data_as(ctypes.c_void_p),
+            sched.ops.shape[0], sched.n_planes, sched.k, sched.r,
+            shards.ctypes.data_as(ctypes.c_char_p), b, s,
+            out.ctypes.data_as(ctypes.c_void_p), nthreads,
+        )
 
     def apply_matrix(self, mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
         b, k, s = shards.shape
@@ -190,6 +259,9 @@ class NativeBackend(ErasureBackend):
             return out
         mat = np.ascontiguousarray(mat, dtype=np.uint8)
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        if s % 8 == 0 and self._xor_enabled():
+            self._xor_apply(mat, shards, out, self.nthreads)
+            return out
         self._lib.cb_apply_matrix(
             mat.ctypes.data_as(ctypes.c_char_p), r, k,
             shards.ctypes.data_as(ctypes.c_char_p), b, s,
@@ -237,11 +309,35 @@ class NativeBackend(ErasureBackend):
                                "outputs")
         mat = np.ascontiguousarray(mat, dtype=np.uint8)
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        nt = self.nthreads if nthreads is None else int(nthreads)
+        if r > 0 and s % 8 == 0 and self._xor_enabled():
+            # XOR-engine ingest: parity via the scheduled program, then
+            # the SHA-NI row hasher over data+parity rows.  Loses the
+            # table path's per-block encode/hash interleave but keeps
+            # the pipeline's slicing contract intact (each stripe slice
+            # arrives here with nthreads=1 and writes only its rows).
+            self._xor_apply(mat, shards, out_parity, nt)
+            # one native call per row family (data, parity), not per
+            # batch item: digests land in flat scratch and scatter into
+            # out_hashes' interleaved rows (a 32-byte-per-row copy)
+            ddig = np.empty((b * k, 32), dtype=np.uint8)
+            self._lib.cb_sha256_rows(
+                shards.ctypes.data_as(ctypes.c_char_p), b * k, s,
+                ddig.ctypes.data_as(ctypes.c_void_p), nt,
+            )
+            out_hashes[:, :k] = ddig.reshape(b, k, 32)
+            pdig = np.empty((b * r, 32), dtype=np.uint8)
+            self._lib.cb_sha256_rows(
+                out_parity.ctypes.data_as(ctypes.c_char_p), b * r, s,
+                pdig.ctypes.data_as(ctypes.c_void_p), nt,
+            )
+            out_hashes[:, k:] = pdig.reshape(b, r, 32)
+            return out_parity, out_hashes
         self._lib.cb_encode_hash(
             mat.ctypes.data_as(ctypes.c_char_p), r, k,
             shards.ctypes.data_as(ctypes.c_char_p), b, s,
             out_parity.ctypes.data_as(ctypes.c_void_p),
             out_hashes.ctypes.data_as(ctypes.c_void_p),
-            self.nthreads if nthreads is None else int(nthreads),
+            nt,
         )
         return out_parity, out_hashes

@@ -54,6 +54,35 @@ class BytesReader:
         return self._buf.readinto(mem)
 
 
+async def open_in_thread(opener, closer):
+    """``asyncio.to_thread(opener)`` with a cancellation guarantee: the
+    opened resource never leaks.  ``to_thread`` alone has a window —
+    cancel the awaiting task while the thread is mid-``open()`` and the
+    handle it returns belongs to nobody, surfacing later as a GC-time
+    ResourceWarning (scrub rolling restarts and hedge losers cancel
+    reads exactly there; tests/test_chaos.py caught it).  The open runs
+    shielded; if the awaiting task is cancelled anyway, ``closer``
+    reaps the orphaned result the moment the thread finishes.  Opener
+    errors propagate unchanged (a failed open returns nothing to
+    close — openers must release partial state themselves)."""
+    t = asyncio.ensure_future(asyncio.to_thread(opener))
+    try:
+        return await asyncio.shield(t)
+    except asyncio.CancelledError:
+        def _reap(task: "asyncio.Task") -> None:
+            if task.cancelled() or task.exception() is not None:
+                return  # retrieving the exception also silences asyncio
+            try:
+                closer(task.result())
+            except Exception:  # lint: broad-except-ok reaping an orphan
+                pass  # nobody is left to hear about a failed close
+        if t.done():
+            _reap(t)
+        else:
+            t.add_done_callback(_reap)
+        raise
+
+
 class FileReader:
     """Thread-offloaded file reader (the spawn_blocking analogue).
 
@@ -73,10 +102,17 @@ class FileReader:
 
     async def _ensure(self) -> io.BufferedReader:
         if self._f is None:
-            f = await asyncio.to_thread(open, self._path, "rb")
-            if self._offset:
-                await asyncio.to_thread(f.seek, self._offset)
-            self._f = f
+            def _open() -> io.BufferedReader:
+                f = open(self._path, "rb")
+                try:
+                    if self._offset:
+                        f.seek(self._offset)
+                except BaseException:
+                    f.close()
+                    raise
+                return f
+
+            self._f = await open_in_thread(_open, lambda f: f.close())
         return self._f
 
     async def read(self, n: int = -1) -> bytes:

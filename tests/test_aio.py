@@ -223,3 +223,64 @@ def test_mmap_opt_out_env_parsing(monkeypatch):
     for val in ("1", "true", "yes", "anything"):
         monkeypatch.setenv("CHUNKY_BITS_TPU_NO_MMAP", val)
         assert aio.mmap_opted_out(), repr(val)
+
+
+def test_open_in_thread_cancel_reaps_orphan():
+    """Cancelling the awaiting task while the open hop is mid-thread
+    must close the orphaned handle instead of abandoning it to GC (the
+    ResourceWarning a scrub rolling restart or a cancelled hedge loser
+    used to trip in tests/test_chaos.py)."""
+    import threading
+
+    gate = threading.Event()
+    opened = []
+
+    class Handle:
+        closed = False
+
+        def close(self):
+            self.closed = True
+
+    def opener():
+        gate.wait(5)
+        h = Handle()
+        opened.append(h)
+        return h
+
+    async def main():
+        task = asyncio.ensure_future(
+            aio.open_in_thread(opener, lambda h: h.close()))
+        await asyncio.sleep(0.05)  # park the thread on the gate
+        task.cancel()
+        gate.set()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        for _ in range(200):  # the reap callback runs when the thread lands
+            if opened and opened[0].closed:
+                break
+            await asyncio.sleep(0.01)
+        assert opened and opened[0].closed
+
+    asyncio.run(main())
+
+
+def test_open_in_thread_plain_paths():
+    """Uncancelled awaits hand the handle over unclosed; opener errors
+    propagate (nothing to reap — a failed open owns its own cleanup)."""
+    class Handle:
+        closed = False
+
+        def close(self):
+            self.closed = True
+
+    async def main():
+        h = await aio.open_in_thread(Handle, lambda x: x.close())
+        assert not h.closed
+
+        def boom():
+            raise FileNotFoundError("nope")
+
+        with pytest.raises(FileNotFoundError):
+            await aio.open_in_thread(boom, lambda x: x.close())
+
+    asyncio.run(main())

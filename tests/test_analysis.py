@@ -281,6 +281,26 @@ def test_device_concat_flagged(tmp_path):
     assert [v.rule for v in vs] == ["CB105"]
 
 
+def test_xor_schedule_module_is_in_cb101_cb105_scope(tmp_path):
+    """The scheduled-XOR engine (ops/xor_schedule.py) sits on the
+    CPU-fallback dispatch path: it must stay inside both the
+    bounded-wait (CB101) and jit-hygiene (CB105) scopes — and the
+    shipped module itself must be clean with zero baseline entries
+    (test_shipped_tree_is_clean covers the latter tree-wide)."""
+    for rid, src in (("CB101", """
+        async def f(task):
+            return await task
+    """), ("CB105", """
+        import jax.numpy as jnp
+
+        def f(a, b):
+            return jnp.concatenate([a, b], axis=1)
+    """)):
+        vs = run_snippet(tmp_path / rid, "ops/xor_schedule.py", src,
+                         select=(rid,))
+        assert [v.rule for v in vs] == [rid], rid
+
+
 # ---- CB106 public-annotations ----
 
 def test_missing_annotations_flagged_on_strict_module(tmp_path):

@@ -197,6 +197,50 @@ def test_config11_failure_emits_one_json_line():
     assert "error" in rec
 
 
+def test_config12_smoke_emits_one_json_line():
+    """--config 12 --smoke (scheduled-XOR engine vs byte-table grid at
+    CI scale) honors the driver contract: exactly one parseable JSON
+    line on stdout with the required keys plus the grid fields, exit
+    0 — and the run itself asserts byte identity between the engines
+    on every cell."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "12", "--smoke"],
+        cwd=REPO, env=env, capture_output=True, timeout=300)
+    assert r.returncode == 0, r.stderr.decode()[-800:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "wins",
+                "cells", "wins_vs_scalar", "best_cell", "schedules",
+                "grid"):
+        assert key in rec
+    assert rec["value"] > 0
+    assert rec["unit"] == "x"
+    assert rec["cells"] == len(rec["grid"]) == 2  # encode + decode
+    for cell in rec["grid"]:
+        assert cell["table_gibps"] > 0 and cell["xor_gibps"] > 0
+
+
+def test_config12_failure_emits_one_json_line():
+    """ANY --config 12 failure (here: invalid parameters) still
+    produces exactly one parseable JSON line and exit 3 — the same
+    contract as configs 8-11 and the device runs."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "12",
+         "--iters", "0"],
+        cwd=REPO, env=env, capture_output=True, timeout=120)
+    assert r.returncode == 3, r.stderr.decode()[-500:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec
+    assert rec["value"] == 0.0
+    assert "error" in rec
+
+
 def test_seams_only_shrink_and_tolerate_garbage():
     """Inherited env values must not break the contract: malformed or
     larger-than-default values fall back to the real budget."""

@@ -59,6 +59,51 @@ def test_random_geometry_roundtrip(seed):
             assert np.array_equal(out[i], full[0, i])
 
 
+@pytest.mark.parametrize("seed", range(6))
+def test_xor_schedule_conformance(seed):
+    """Scheduled-XOR leg of the sweep: random geometry / stripe-edge
+    lengths / random erasure patterns, asserting the engine (numpy
+    reference executor AND the native cb_xor_exec dispatch) emits
+    byte-identical parity and byte-identical reconstructions — the
+    same decode route the ReconstructBatcher and the RepairPlanner's
+    decode plans dispatch through (reconstruct_batch_picked)."""
+    from chunky_bits_tpu.ops import xor_schedule
+
+    rng = np.random.default_rng(500 + seed)
+    d = int(rng.integers(1, 17))
+    p = int(rng.integers(1, 9))
+    # stripe-edge but plane-eligible lengths (S % 8 == 0); the odd
+    # lengths' fall-back-to-table identity is pinned in
+    # tests/test_xor_schedule.py
+    size = int(rng.integers(1, 300)) * 8
+    batch = int(rng.integers(1, 4))
+
+    data = rng.integers(0, 256, (batch, d, size), dtype=np.uint8)
+    numpy_coder = ErasureCoder(d, p, NumpyBackend())
+    try:
+        from chunky_bits_tpu.ops.cpu_backend import NativeBackend
+
+        xor_coder = ErasureCoder(d, p, NativeBackend(xor_schedule=True))
+    except Exception as err:  # pragma: no cover - no compiler in env
+        pytest.skip(f"native backend unavailable: {err}")
+
+    parity_np = numpy_coder.encode_batch(data)
+    assert np.array_equal(parity_np, xor_coder.encode_batch(data))
+    sched = xor_schedule.get_schedule(xor_coder.parity_rows)
+    assert np.array_equal(parity_np,
+                          xor_schedule.apply_numpy(sched, data))
+
+    full = np.concatenate([data, parity_np], axis=1)
+    for _ in range(4):
+        n_erase = int(rng.integers(1, p + 1))
+        erased = rng.choice(d + p, size=n_erase, replace=False)
+        shards = [None if i in erased else full[0, i]
+                  for i in range(d + p)]
+        out = xor_coder.reconstruct(list(shards))
+        for i in range(d + p):
+            assert np.array_equal(out[i], full[0, i]), (d, p, erased, i)
+
+
 @pytest.mark.parametrize("seed", range(4))
 def test_too_many_erasures_raise(seed):
     rng = np.random.default_rng(100 + seed)

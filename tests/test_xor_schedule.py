@@ -1,0 +1,335 @@
+"""The scheduled-XOR erasure engine (ops/xor_schedule.py + cb_xor_exec).
+
+Pins the engine's three contracts:
+
+* **byte identity** — schedules executed by the numpy reference
+  executor AND the native engine (at every forced kernel tier,
+  including the pinned scalar fallback) produce exactly the table
+  codec's bytes, for encode, decode-with-erasures, and the fused
+  ingest path, flag on or off;
+* **bounded schedule cache** — LRU by matrix digest, capacity
+  respected, eviction observable;
+* **program well-formedness** — every temp defined before use, every
+  output seeded by a copy/zero, CSE never above the raw XOR count.
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from chunky_bits_tpu.ops import matrix, xor_schedule
+from chunky_bits_tpu.ops.backend import (ErasureCoder, NumpyBackend,
+                                         register_backend)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _native(**kwargs):
+    try:
+        from chunky_bits_tpu.ops.cpu_backend import NativeBackend
+
+        return NativeBackend(**kwargs)
+    except Exception as err:  # pragma: no cover - no compiler in env
+        pytest.skip(f"native backend unavailable: {err}")
+
+
+@pytest.fixture
+def force_impl():
+    """Force the XOR engine's kernel tier for one test, restoring the
+    detected best afterwards (the toggle is process-wide)."""
+    from chunky_bits_tpu.ops import cpu_backend
+
+    forced = []
+
+    def force(level: int) -> int:
+        eff = cpu_backend.xor_force_impl(level)
+        forced.append(eff)
+        return eff
+
+    yield force
+    cpu_backend.xor_force_impl(2)
+
+
+# ---- schedule structure ----
+
+def test_schedule_well_formed_and_cse_reduces():
+    enc = matrix.build_encode_matrix(10, 4)
+    sched = xor_schedule.build_schedule(enc[10:])
+    assert sched.k == 10 and sched.r == 4
+    n_in, out_base = 8 * sched.k, sched.out_base
+    defined = set(range(n_in))
+    seeded = set()
+    for dst, src, kind in sched.ops.tolist():
+        assert 0 <= dst < sched.n_planes
+        if kind == xor_schedule.OP_ZERO:
+            assert dst >= out_base
+        else:
+            assert src in defined, "use before def"
+        if kind == xor_schedule.OP_XOR and dst >= out_base:
+            assert dst in seeded, "output XOR before its seeding copy"
+        if kind in (xor_schedule.OP_COPY, xor_schedule.OP_ZERO):
+            seeded.add(dst)
+        defined.add(dst)
+    # every output plane is produced
+    assert set(range(out_base, sched.n_planes)) <= seeded | defined
+    # CSE strictly reduces plane ops vs the raw one-XOR-per-set-bit
+    # program (8r of which become the seeding copies)
+    assert len(sched.ops) < sched.raw_xors
+    assert sched.n_xors < sched.raw_xors - 8 * sched.r
+
+
+def test_identity_and_zero_rows_schedule():
+    """Decode matrices contain identity rows (pass-through shards) and
+    the builder must handle all-zero rows without emitting garbage."""
+    mat = np.zeros((2, 3), dtype=np.uint8)
+    mat[0, 1] = 1  # identity row: out0 = shard1
+    sched = xor_schedule.build_schedule(mat)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (2, 3, 64), dtype=np.uint8)
+    out = xor_schedule.apply_numpy(sched, data)
+    assert np.array_equal(out[:, 0], data[:, 1])
+    assert not out[:, 1].any()
+
+
+def test_planes_roundtrip():
+    rng = np.random.default_rng(1)
+    rows = rng.integers(0, 256, (5, 128), dtype=np.uint8)
+    planes = xor_schedule.planes_of(rows)
+    assert planes.shape == (40, 16)
+    assert np.array_equal(xor_schedule.bytes_of(planes), rows)
+    # convention anchor: plane v, byte t8, bit b = bit v of byte 8*t8+b
+    one = np.zeros((1, 8), dtype=np.uint8)
+    one[0, 3] = 1 << 5  # bit 5 of byte 3
+    p = xor_schedule.planes_of(one)
+    assert p[5, 0] == 1 << 3 and p.sum() == (1 << 3)
+
+
+# ---- executor identity (numpy reference + native, all kernel tiers) ----
+
+@pytest.mark.parametrize("seed", range(6))
+def test_numpy_executor_matches_table_codec(seed):
+    rng = np.random.default_rng(seed)
+    d = int(rng.integers(1, 17))
+    p = int(rng.integers(1, 9))
+    size = int(rng.integers(1, 300)) * 8
+    batch = int(rng.integers(1, 4))
+    enc = matrix.build_encode_matrix(d, p)
+    data = rng.integers(0, 256, (batch, d, size), dtype=np.uint8)
+    want = NumpyBackend().apply_matrix(enc[d:], data)
+    sched = xor_schedule.get_schedule(enc[d:])
+    assert np.array_equal(xor_schedule.apply_numpy(sched, data), want)
+
+
+@pytest.mark.parametrize("level", [0, 1, 2])
+def test_native_engine_identity_per_tier(level, force_impl):
+    """Encode AND decode byte identity at every kernel tier — level 0
+    pins the scalar fallback (the forced-path discipline of the
+    SHA-NI/GFNI fixes: the portable path is tested, not trusted)."""
+    eff = force_impl(level)
+    if eff != level:
+        pytest.skip(f"tier {level} unavailable (clamped to {eff})")
+    off = _native(xor_schedule=False)
+    on = _native(xor_schedule=True)
+    rng = np.random.default_rng(100 + level)
+    for d, p, size, batch in ((3, 2, 64, 2), (10, 4, 1024, 2),
+                              (1, 1, 8, 1), (16, 8, 1992, 1),
+                              (20, 6, 8192, 1), (4, 4, 16, 3)):
+        enc = matrix.build_encode_matrix(d, p)
+        data = rng.integers(0, 256, (batch, d, size), dtype=np.uint8)
+        want = off.apply_matrix(enc[d:], data)
+        assert np.array_equal(on.apply_matrix(enc[d:], data), want), \
+            (d, p, size)
+        full = np.concatenate([data, want], axis=1)
+        erased = rng.choice(d + p, size=p, replace=False)
+        present = [i for i in range(d + p) if i not in erased]
+        dec = matrix.decode_matrix(enc, present, sorted(erased))
+        picked = np.ascontiguousarray(full[:, np.array(present[:d]), :])
+        assert np.array_equal(on.apply_matrix(dec, picked),
+                              off.apply_matrix(dec, picked)), (d, p, size)
+
+
+def test_non_multiple_of_8_falls_back_to_table_path():
+    on = _native(xor_schedule=True)
+    off = _native(xor_schedule=False)
+    rng = np.random.default_rng(7)
+    enc = matrix.build_encode_matrix(3, 2)
+    for size in (1, 7, 9, 1001):
+        data = rng.integers(0, 256, (2, 3, size), dtype=np.uint8)
+        assert np.array_equal(on.apply_matrix(enc[3:], data),
+                              off.apply_matrix(enc[3:], data)), size
+
+
+def test_encode_and_hash_into_identity_with_flag_on():
+    """The fused ingest entry point — the shape the HostPipeline slices
+    (nthreads=1 per stripe range) — must emit identical parity AND
+    digests with the engine on."""
+    off = _native(xor_schedule=False)
+    on = _native(xor_schedule=True)
+    rng = np.random.default_rng(8)
+    for d, p, size, batch in ((3, 2, 4096, 4), (10, 4, 1 << 16, 2),
+                              (2, 0, 512, 2)):
+        enc = matrix.build_encode_matrix(d, p)
+        data = rng.integers(0, 256, (batch, d, size), dtype=np.uint8)
+        p1, h1 = off.encode_and_hash(enc[d:], data)
+        p2, h2 = on.encode_and_hash(enc[d:], data)
+        assert np.array_equal(p1, p2), (d, p, size)
+        assert np.array_equal(h1, h2), (d, p, size)
+        # and the sliced pipeline shape: caller-provided output rows
+        par = np.zeros((batch, p, size), dtype=np.uint8)
+        dig = np.zeros((batch, d + p, 32), dtype=np.uint8)
+        on.encode_and_hash_into(enc[d:], data, par, dig, 1)
+        assert np.array_equal(par, p1) and np.array_equal(dig, h1)
+
+
+def test_host_pipeline_slicing_identity_with_flag_on():
+    from chunky_bits_tpu.parallel.host_pipeline import HostPipeline
+
+    on = _native(xor_schedule=True)
+    coder = ErasureCoder(10, 4, on)
+    rng = np.random.default_rng(9)
+    stacked = rng.integers(0, 256, (8, 10, 4096), dtype=np.uint8)
+    want_p, want_h = ErasureCoder(
+        10, 4, _native(xor_schedule=False)).encode_hash_batch(stacked)
+    pipe = HostPipeline(threads=3)
+    try:
+        got_p, got_h = pipe.encode_hash_sync(coder, stacked)
+    finally:
+        pipe.close()
+    assert np.array_equal(got_p, want_p)
+    assert np.array_equal(got_h, want_h)
+
+
+def test_reconstruct_batcher_decode_path_with_flag_on():
+    """The decode-plan route the read path, resilver and the
+    RepairPlanner all share: ReconstructBatcher ->
+    reconstruct_batch_picked -> NativeBackend.apply_matrix — schedules
+    come out of the shared LRU keyed by the decode matrix digest."""
+    from chunky_bits_tpu.ops.batching import ReconstructBatcher
+
+    be = _native(xor_schedule=True)
+    be.name = "native-xorsched-test"
+    register_backend(be)
+    rng = np.random.default_rng(10)
+    d, p, size = 5, 3, 2048
+    coder = ErasureCoder(d, p, NumpyBackend())
+    data = rng.integers(0, 256, (1, d, size), dtype=np.uint8)
+    full = np.concatenate([data, coder.encode_batch(data)], axis=1)
+
+    async def run():
+        batcher = ReconstructBatcher(backend="native-xorsched-test")
+        erased = [1, 4, 6]
+        arrays = [None if i in erased else full[0, i]
+                  for i in range(d + p)]
+        out = await batcher.reconstruct(d, p, arrays)
+        await batcher.aclose()
+        return out
+
+    out = asyncio.run(run())
+    for i in range(d + p):
+        assert np.array_equal(out[i], full[0, i]), i
+
+
+# ---- the bounded schedule LRU ----
+
+def test_schedule_cache_bound_and_eviction():
+    cache = xor_schedule.ScheduleCache(maxsize=3)
+    rng = np.random.default_rng(11)
+    mats = [rng.integers(1, 256, (2, 3), dtype=np.uint8)
+            for _ in range(5)]
+    scheds = [cache.get(m) for m in mats]
+    assert len(cache) == 3
+    info = cache.info()
+    assert info["misses"] == 5 and info["evictions"] == 2
+    # most-recent entries hit; the oldest was evicted and rebuilds
+    assert cache.get(mats[-1]) is scheds[-1]
+    assert cache.info()["hits"] == 1
+    again = cache.get(mats[0])
+    assert again is not scheds[0]
+    assert np.array_equal(again.ops, scheds[0].ops)
+    assert cache.info()["misses"] == 6
+
+
+def test_schedule_cache_lru_order():
+    cache = xor_schedule.ScheduleCache(maxsize=2)
+    a = np.array([[1, 2]], dtype=np.uint8)
+    b = np.array([[3, 4]], dtype=np.uint8)
+    c = np.array([[5, 6]], dtype=np.uint8)
+    sa = cache.get(a)
+    cache.get(b)
+    assert cache.get(a) is sa  # refresh a
+    cache.get(c)               # evicts b, not a
+    assert cache.get(a) is sa
+    assert cache.info()["evictions"] == 1
+
+
+def test_shared_cache_is_used_by_dispatch():
+    on = _native(xor_schedule=True)
+    rng = np.random.default_rng(12)
+    mat = rng.integers(1, 256, (2, 4), dtype=np.uint8)
+    data = rng.integers(0, 256, (1, 4, 64), dtype=np.uint8)
+    before = xor_schedule.schedule_cache_info()
+    on.apply_matrix(mat, data)
+    on.apply_matrix(mat, data)
+    after = xor_schedule.schedule_cache_info()
+    assert after["misses"] == before["misses"] + 1
+    assert after["hits"] >= before["hits"] + 1
+
+
+# ---- flag plumbing ----
+
+def test_tunables_accessor_parses_standard_flag_shapes(monkeypatch):
+    from chunky_bits_tpu.cluster import tunables
+
+    monkeypatch.delenv(tunables.XOR_SCHEDULE_ENV, raising=False)
+    assert tunables.xor_schedule_enabled() is False
+    for raw, want in (("1", True), ("on", True), ("0", False),
+                      ("false", False), ("", False)):
+        monkeypatch.setenv(tunables.XOR_SCHEDULE_ENV, raw)
+        assert tunables.xor_schedule_enabled() is want, raw
+
+
+def test_flag_read_at_first_dispatch(monkeypatch):
+    from chunky_bits_tpu.cluster import tunables
+
+    monkeypatch.setenv(tunables.XOR_SCHEDULE_ENV, "1")
+    be = _native()
+    assert be._xor is None  # not read at construction
+    rng = np.random.default_rng(13)
+    mat = rng.integers(1, 256, (1, 2), dtype=np.uint8)
+    be.apply_matrix(mat, rng.integers(0, 256, (1, 2, 8), dtype=np.uint8))
+    assert be._xor is True
+    # baked: flipping the env after first dispatch changes nothing
+    monkeypatch.setenv(tunables.XOR_SCHEDULE_ENV, "0")
+    assert be._xor_enabled() is True
+
+
+# ---- golden fixtures stay byte-identical with the flag on ----
+
+def test_golden_fixtures_identical_with_flag_on():
+    """End to end through the cluster write path in a fresh process
+    with $CHUNKY_BITS_TPU_XOR_SCHEDULE=1: every golden fixture must
+    reproduce byte-for-byte (content addresses pin the parity bytes),
+    and the engine must actually have dispatched."""
+    prog = (
+        "import asyncio, os\n"
+        "from tests.golden import generate as gen\n"
+        "from chunky_bits_tpu.ops import xor_schedule\n"
+        "refs = asyncio.run(gen.build_refs())\n"
+        "for name, obj in refs.items():\n"
+        "    with open(os.path.join(gen.GOLDEN_DIR, name + '.yaml')) as f:\n"
+        "        assert gen.dump(obj) == f.read(), name\n"
+        "info = xor_schedule.schedule_cache_info()\n"
+        "assert info['misses'] > 0, 'xor engine never dispatched'\n"
+        "print('golden ok', info['misses'])\n"
+    )
+    env = dict(os.environ, PYTHONPATH=REPO,
+               CHUNKY_BITS_TPU_XOR_SCHEDULE="1",
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", prog], cwd=REPO, env=env,
+                       capture_output=True, timeout=300)
+    assert r.returncode == 0, r.stderr.decode()[-800:]
+    assert b"golden ok" in r.stdout
