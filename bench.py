@@ -2044,6 +2044,246 @@ def bench_repair_bandwidth(argv=()) -> None:
         sys.exit(3)
 
 
+def bench_pm_msr_repair(argv=()) -> None:
+    """BASELINE.md config 13: product-matrix MSR regenerating code vs
+    Reed-Solomon repair bandwidth (CPU-only, no device, no watchdog).
+
+    The config-11-style single-chunk-loss workload: many one-part
+    objects at d=5 p=4, one data chunk's ONLY replica deleted on a
+    corrupt subset (whole-chunk loss — the regime regenerating codes
+    exist for), one scrub/repair pass per leg.  The ``rs`` leg repairs
+    through the planner's decode plan at the classic information-
+    theoretic floor (d whole-chunk helper reads per rebuilt chunk);
+    the ``pm-msr`` leg (ops/pm_msr.py) regenerates from d' = 2(d-1)
+    β-sized helper projections — d'·β = 2·chunksize helper bytes, i.e.
+    d/2 = 2.5x below the rs floor at this geometry.
+
+    Reported per leg: helper bytes read per rebuilt byte (the headline
+    — the planner's per-code counters, exactly the repair-plane bytes
+    a distributed deployment would move), scrub wall time, and the
+    disk-side read delta (``cb_io_bytes_total{op=read}`` minus
+    verification bytes — the local-helper full reads the projections
+    are computed from, reported honestly alongside).  In-run asserts:
+    repaired objects byte-identical to their payloads; pm-msr encode
+    and repair byte-identical between the numpy and native backends;
+    exact bucket-sum equality per plan (rs: plans·d·chunk; pm-msr:
+    plans·d'·β — the config-11 accounting discipline).
+
+    Flags: ``--objects N`` (default 120), ``--corrupt N`` (default 30),
+    ``--chunk-log2 N`` (default 14 = 16 KiB), ``--smoke`` (CI-scale:
+    20 objects, 6 corrupt).
+
+    Failure contract (tests/test_bench_outage.py): ANY failure still
+    emits exactly one parseable JSON line and exits 3."""
+    import asyncio
+    import contextlib
+    import os
+    import random as _random
+    import tempfile
+
+    argv = list(argv)
+
+    def flag(name, default, cast):
+        if name in argv:
+            return cast(argv[argv.index(name) + 1])
+        return default
+
+    metric = "pm_msr_repair_bytes_reduction_d5p4"
+    try:
+        objects = flag("--objects", 120, int)
+        corrupt = flag("--corrupt", 30, int)
+        chunk_log2 = flag("--chunk-log2", 14, int)
+        if "--smoke" in argv:
+            objects = min(objects, 20)
+            corrupt = min(corrupt, 6)
+        if objects <= 0 or corrupt <= 0 or corrupt > objects:
+            raise ValueError(
+                "--objects and --corrupt must be positive, "
+                "corrupt <= objects")
+        if not (12 <= chunk_log2 <= 22):
+            raise ValueError("--chunk-log2 out of range [12, 22]")
+
+        from chunky_bits_tpu.cluster import Cluster
+        from chunky_bits_tpu.cluster.scrub import ScrubDaemon
+        from chunky_bits_tpu.file.profiler import new_profiler
+        from chunky_bits_tpu.obs.metrics import get_registry
+        from chunky_bits_tpu.ops.backend import NumpyBackend, get_coder
+        from chunky_bits_tpu.ops.pm_msr import PMMSRCoder
+        from chunky_bits_tpu.utils import aio
+
+        d, p = 5, 4
+        alpha, dh = d - 1, 2 * (d - 1)
+        chunk_bytes = 1 << chunk_log2
+        beta = chunk_bytes // alpha
+        rng = np.random.default_rng(0)
+        payloads = [rng.integers(0, 256, d * chunk_bytes,
+                                 dtype=np.uint8).tobytes()
+                    for _ in range(objects)]
+        picks = _random.Random(7)
+        # (object index, lost data-chunk slot) per victim — identical
+        # whole-chunk loss for both legs
+        damage = [(i, picks.randrange(d))
+                  for i in picks.sample(range(objects), corrupt)]
+
+        # in-run backend identity: the pm-msr matrices must produce
+        # byte-identical parity AND regenerations on numpy and native
+        # (the same invariant the conformance fuzz pins; asserted here
+        # so a bench round can never report a win off divergent math)
+        c_np = PMMSRCoder(d, p, NumpyBackend())
+        c_nat = get_coder(d, p, "native", code="pm-msr")
+        sample = rng.integers(0, 256, (2, d, 8 * alpha), dtype=np.uint8)
+        par_np = c_np.encode_batch(sample)
+        if not np.array_equal(par_np, c_nat.encode_batch(sample)):
+            raise RuntimeError("pm-msr parity differs numpy vs native")
+        full = np.concatenate([sample, par_np], axis=1)
+        helpers = [i for i in range(d + p) if i != 1][:dh]
+        projs = np.stack([c_np.project_batch(1, full[:, h, :])
+                          for h in helpers], axis=1)
+        regen_np = c_np.repair_batch(1, helpers, projs)
+        if not (np.array_equal(regen_np,
+                               c_nat.repair_batch(1, helpers, projs))
+                and np.array_equal(regen_np, full[:, 1, :])):
+            raise RuntimeError("pm-msr regeneration differs or is wrong")
+
+        def make_cluster(root: str, code: str) -> Cluster:
+            dirs = []
+            for i in range(d + p):
+                disk = os.path.join(root, f"disk{i}")
+                os.makedirs(disk, exist_ok=True)
+                dirs.append(disk)
+            meta = os.path.join(root, "meta")
+            os.makedirs(meta, exist_ok=True)
+            return Cluster.from_obj({
+                "destinations": [{"location": x} for x in dirs],
+                "metadata": {"type": "path", "format": "yaml",
+                             "path": meta},
+                "profiles": {"default": {
+                    "data": d, "parity": p,
+                    "chunk_size": chunk_log2, "code": code}},
+            })
+
+        def read_bytes_total() -> float:
+            for fam in get_registry().snapshot()["families"]:
+                if fam["name"] == "cb_io_bytes_total":
+                    return sum(s["value"] for s in fam["samples"]
+                               if s["labels"].get("op") == "read")
+            return 0.0
+
+        async def run_leg(root: str, code: str) -> dict:
+            cluster = make_cluster(root, code)
+            profile = cluster.get_profile(None)
+            for i, payload in enumerate(payloads):
+                await cluster.write_file(
+                    f"o{i:04d}", aio.BytesReader(payload), profile)
+            for i, slot in damage:
+                ref = await cluster.get_file_ref(f"o{i:04d}")
+                os.remove(ref.parts[0].data[slot].locations[0].target)
+            profiler, _reporter = new_profiler()
+            daemon = ScrubDaemon(cluster, bytes_per_sec=0,
+                                 planner=True, profiler=profiler)
+            read_before = read_bytes_total()
+            stats = await daemon.run_once()
+            read_after = read_bytes_total()
+            rep = stats.repair or {}
+            leg = rep.get("by_code", {}).get(code, {})
+            if stats.repaired < corrupt:
+                raise RuntimeError(
+                    f"leg code={code}: repaired={stats.repaired}, "
+                    f"expected {corrupt}")
+            # exact per-plan helper-byte accounting (the config-11
+            # bucket-sum discipline): every counted helper byte is a
+            # byte the plan shape predicts, no estimates
+            if code == "pm-msr":
+                if leg.get("plans_msr") != corrupt:
+                    raise RuntimeError(f"pm-msr plans: {leg}")
+                want = corrupt * dh * beta
+                if leg.get("helper_bytes_msr") != want:
+                    raise RuntimeError(
+                        f"helper_bytes_msr {leg.get('helper_bytes_msr')}"
+                        f" != plans*d'*beta {want}")
+                helper_b = leg.get("helper_bytes_msr", 0)
+            else:
+                if leg.get("plans_decode") != corrupt:
+                    raise RuntimeError(f"rs plans: {leg}")
+                want = corrupt * d * chunk_bytes
+                if leg.get("helper_bytes_decode") != want:
+                    raise RuntimeError(
+                        f"helper_bytes_decode "
+                        f"{leg.get('helper_bytes_decode')} != "
+                        f"plans*d*chunk {want}")
+                helper_b = leg.get("helper_bytes_decode", 0)
+            for i, _slot in damage:
+                ref = await cluster.get_file_ref(f"o{i:04d}")
+                body = await cluster.file_read_builder(ref).read_all()
+                assert body == payloads[i], \
+                    f"byte identity failed (code={code}, obj {i})"
+            rebuilt_b = leg.get("bytes_rebuilt", 0)
+            out = {
+                "helper_b": helper_b,
+                "bytes_per_rebuilt": helper_b / float(rebuilt_b or 1),
+                "disk_read_b": read_after - read_before
+                - stats.bytes_verified,
+                "wall_s": stats.last_pass_seconds,
+                "repair": rep,
+            }
+            await cluster.tunables.location_context().aclose()
+            return out
+
+        async def run() -> tuple:
+            with contextlib.ExitStack() as stack:
+                rs_root = stack.enter_context(
+                    tempfile.TemporaryDirectory())
+                pm_root = stack.enter_context(
+                    tempfile.TemporaryDirectory())
+                rs = await run_leg(rs_root, "rs")
+                pm = await run_leg(pm_root, "pm-msr")
+            return rs, pm
+
+        rs, pm = asyncio.run(run())
+        reduction = (rs["bytes_per_rebuilt"] / pm["bytes_per_rebuilt"]
+                     if pm["bytes_per_rebuilt"] > 0 else 0.0)
+        print(f"# config 13: {objects} x {d}x{chunk_bytes >> 10} KiB "
+              f"objects d={d} p={p}, {corrupt} single-chunk losses — "
+              f"helper bytes {rs['helper_b'] / 1024:.0f} KiB rs vs "
+              f"{pm['helper_b'] / 1024:.0f} KiB pm-msr "
+              f"({rs['bytes_per_rebuilt']:.2f} vs "
+              f"{pm['bytes_per_rebuilt']:.2f} B/rebuilt B, "
+              f"{reduction:.2f}x less; rs floor is d={d}, pm-msr is "
+              f"d'/alpha={dh}/{alpha}) | disk reads "
+              f"{rs['disk_read_b'] / 1024:.0f} vs "
+              f"{pm['disk_read_b'] / 1024:.0f} KiB | scrub pass "
+              f"{rs['wall_s']:.2f}s vs {pm['wall_s']:.2f}s",
+              file=sys.stderr)
+        print(json.dumps({
+            "metric": metric,
+            "value": round(reduction, 3), "unit": "x",
+            # acceptance: pm-msr >= 1.5x below the rs d x damage floor
+            "vs_baseline": round(reduction / 1.5, 3),
+            "objects": objects, "corrupt": corrupt,
+            "chunk_kib": chunk_bytes >> 10,
+            "data": d, "parity": p, "alpha": alpha, "helpers": dh,
+            "helper_b_rs": int(rs["helper_b"]),
+            "helper_b_pm": int(pm["helper_b"]),
+            "bytes_per_rebuilt_rs": round(rs["bytes_per_rebuilt"], 3),
+            "bytes_per_rebuilt_pm": round(pm["bytes_per_rebuilt"], 3),
+            "disk_read_rs_b": int(rs["disk_read_b"]),
+            "disk_read_pm_b": int(pm["disk_read_b"]),
+            "plans_msr": pm["repair"].get("plans_msr", 0),
+            "plans_decode_rs": rs["repair"].get("plans_decode", 0),
+            "wall_rs_s": round(rs["wall_s"], 3),
+            "wall_pm_s": round(pm["wall_s"], 3),
+        }))
+    # lint: broad-except-ok the driver contract (ONE parseable JSON
+    # line, always) outranks the traceback; the error text carries it
+    except Exception as err:
+        print(json.dumps({
+            "metric": metric, "value": 0.0, "unit": "x",
+            "vs_baseline": 0.0,
+            "error": f"{type(err).__name__}: {err}",
+        }))
+        sys.exit(3)
+
+
 def bench_xor_schedule(argv=()) -> None:
     """BASELINE.md config 12: scheduled-XOR erasure engine vs the
     byte-table kernels (CPU-only, no tunnel, no gateway).
@@ -2238,12 +2478,13 @@ if __name__ == "__main__":
                    "9": lambda: bench_gateway_scaleout(sys.argv),
                    "10": lambda: bench_slab_store(sys.argv),
                    "11": lambda: bench_repair_bandwidth(sys.argv),
-                   "12": lambda: bench_xor_schedule(sys.argv)}
+                   "12": lambda: bench_xor_schedule(sys.argv),
+                   "13": lambda: bench_pm_msr_repair(sys.argv)}
         idx = sys.argv.index("--config") + 1
         which = sys.argv[idx] if idx < len(sys.argv) else ""
         if which not in configs:
             print(f"usage: bench.py [--config "
-                  f"{{1,2,3,4,6,7,8,9,10,11,12}}]"
+                  f"{{1,2,3,4,6,7,8,9,10,11,12,13}}]"
                   f" — the device kernel metric (configs 2+3's compute "
                   f"core) is the default no-arg run (got {which!r}); 6 "
                   f"is the hot-read cache A/B, 7 the gateway PUT ingest "
@@ -2251,7 +2492,9 @@ if __name__ == "__main__":
                   f"gateway scale-out multi-worker A/B, 10 the packed "
                   f"slab store vs file-per-chunk A/B, 11 the "
                   f"repair-bandwidth planner A/B, 12 the scheduled-XOR "
-                  f"erasure engine vs byte-table grid (all CPU-only)",
+                  f"erasure engine vs byte-table grid, 13 the pm-msr "
+                  f"regenerating-code vs rs repair-bandwidth A/B (all "
+                  f"CPU-only)",
                   file=sys.stderr)
             sys.exit(2)
         configs[which]()

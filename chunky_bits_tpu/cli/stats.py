@@ -101,18 +101,33 @@ def render_summary(stats: dict, healthz: dict, scrub: dict,
         repair = scrub.get("repair")
         if repair is not None:
             # the planner's counters (cluster/repair.py RepairStats —
-            # the same numbers behind the cb_repair_* families)
-            helper = (repair.get("helper_bytes_replica", 0)
-                      + repair.get("helper_bytes_decode", 0))
+            # the same numbers behind the cb_repair_* families);
+            # msr = pm-msr β-projection regenerations (ops/pm_msr.py)
+            def helper_bytes(row: dict) -> int:
+                return (row.get("helper_bytes_replica", 0)
+                        + row.get("helper_bytes_decode", 0)
+                        + row.get("helper_bytes_msr", 0))
+
+            helper = helper_bytes(repair)
             ratio = repair.get("helper_bytes_per_rebuilt_byte")
             line = (f"repair: plans copy={repair.get('plans_copy', 0)} "
                     f"decode={repair.get('plans_decode', 0)} "
+                    f"msr={repair.get('plans_msr', 0)} "
                     f"fallback={repair.get('plans_fallback', 0)} "
                     f"helperB={helper} "
                     f"rebuiltB={repair.get('bytes_rebuilt', 0)}")
             if ratio is not None:
                 line += f" helperB/rebuiltB={ratio:.2f}"
             print(line, file=out)
+            by_code = repair.get("by_code") or {}
+            active = {c: v for c, v in sorted(by_code.items())
+                      if any(v.get(k, 0) for k in v)}
+            if len(active) > 1 or (active and "rs" not in active):
+                for code_name, v in active.items():
+                    print(f"repair[{code_name}]: "
+                          f"helperB={helper_bytes(v)} "
+                          f"rebuiltB={v.get('bytes_rebuilt', 0)}",
+                          file=out)
     else:
         print("scrub: disabled", file=out)
 

@@ -214,6 +214,7 @@ class Cluster:
             .with_encode_batcher(self._encode_batcher)
             .with_host_pipeline(self.host_pipeline())
             .with_repair_block_bytes(self.tunables.repair_block_bytes)
+            .with_code(profile.get_code())
         )
 
     async def write_file_ref(self, path: str,

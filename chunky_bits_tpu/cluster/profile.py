@@ -55,6 +55,13 @@ class ClusterProfile:
     data_chunks: int = sized_int.DATA_DEFAULT
     parity_chunks: int = sized_int.PARITY_DEFAULT
     zone_rules: dict[str, ZoneRule] = field(default_factory=dict)
+    #: erasure code for parts written under this profile: "rs" /
+    #: "pm-msr" when pinned in YAML (validated against the geometry at
+    #: parse time), or None = unset — ``get_code`` then resolves the
+    #: ``$CHUNKY_BITS_TPU_CODE`` env default, honored only when this
+    #: profile's geometry supports it (an env default must tune, never
+    #: break, a fleet of mixed profiles)
+    code: Optional[str] = None
 
     def get_chunk_size(self) -> int:
         return 1 << self.chunk_size
@@ -64,6 +71,16 @@ class ClusterProfile:
 
     def get_parity_chunks(self) -> int:
         return self.parity_chunks
+
+    def get_code(self) -> str:
+        if self.code is not None:
+            return self.code
+        from chunky_bits_tpu.cluster import tunables
+
+        want = tunables.erasure_code(default="rs")
+        if want != "rs" and _code_geometry_error(want, self) is not None:
+            return "rs"
+        return want
 
     @classmethod
     def from_obj(cls, obj: dict) -> "ClusterProfile":
@@ -85,15 +102,20 @@ class ClusterProfile:
             out.zone_rules = {
                 zone: ZoneRule.from_obj(rule) for zone, rule in rules.items()
             }
+        if "code" in obj and obj["code"] is not None:
+            out.code = _validated_code(obj["code"], out)
         return out
 
     def to_obj(self) -> dict:
-        return {
+        out = {
             "chunk_size": self.chunk_size,
             "data_chunks": self.data_chunks,
             "parity_chunks": self.parity_chunks,
             "rules": {z: r.to_obj() for z, r in self.zone_rules.items()},
         }
+        if self.code is not None:
+            out["code"] = self.code
+        return out
 
     def copy(self) -> "ClusterProfile":
         return ClusterProfile(
@@ -101,6 +123,7 @@ class ClusterProfile:
             data_chunks=self.data_chunks,
             parity_chunks=self.parity_chunks,
             zone_rules={z: r.copy() for z, r in self.zone_rules.items()},
+            code=self.code,
         )
 
 
@@ -109,6 +132,33 @@ def _zone_rules_obj(obj: dict):
         if key in obj and obj[key] is not None:
             return obj[key]
     return None
+
+
+def _code_geometry_error(code: str, profile: "ClusterProfile"):
+    """Why ``profile``'s geometry cannot run ``code``, or None."""
+    if code == "rs":
+        return None
+    from chunky_bits_tpu.ops.pm_msr import geometry_error
+
+    return geometry_error(profile.get_data_chunks(),
+                          profile.get_parity_chunks(),
+                          profile.get_chunk_size())
+
+
+def _validated_code(value: object, profile: "ClusterProfile") -> str:
+    """An explicit YAML ``code:`` must be a shipped code AND fit the
+    profile's geometry — config typos and impossible geometries fail at
+    cluster load, not at the first write."""
+    from chunky_bits_tpu.ops.backend import KNOWN_CODES
+
+    if value not in KNOWN_CODES:
+        raise SerdeError(
+            f"profile code must be one of "
+            f"{', '.join(repr(c) for c in KNOWN_CODES)}, got {value!r}")
+    err = _code_geometry_error(str(value), profile)
+    if err is not None:
+        raise SerdeError(f"profile cannot use code {value!r}: {err}")
+    return str(value)
 
 
 class ClusterProfiles:
@@ -183,4 +233,19 @@ def _merge_with_default(hollow: dict, default: ClusterProfile
                 out.zone_rules.pop(zone, None)
             else:
                 out.zone_rules[zone] = ZoneRule.from_obj(rule)
+    if "code" in hollow:
+        # null removes the inherited pin (back to the env default),
+        # mirroring the zone-rule null semantics
+        out.code = (None if hollow["code"] is None
+                    else _validated_code(hollow["code"], out))
+    elif out.code is not None:
+        # an inherited explicit code must still fit the merged
+        # geometry — a custom profile that widens data past the
+        # default's pm-msr parity budget is a config error, not a
+        # silent fallback (explicit pins are guarantees)
+        err = _code_geometry_error(out.code, out)
+        if err is not None:
+            raise SerdeError(
+                f"profile inherits code {out.code!r} but its geometry "
+                f"cannot run it: {err}")
     return out

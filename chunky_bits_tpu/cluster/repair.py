@@ -17,24 +17,42 @@ file/chunk.py ``BlockDigests``, written on the normal encode path when
 ``tunables.repair_block_bytes`` is set) and repairing only the stripes
 that need it.
 
-Three plan kinds, cheapest first:
+Four plan kinds, cheapest first:
 
 * **copy** — the damaged chunk still has a healthy replica: read the
   damaged ranges (or, without a digest tree, the whole chunk) from that
   ONE replica and rewrite the victims in place.  1x bytes per rebuilt
   byte instead of the d x a decode would cost.
+* **msr** — a ``pm-msr`` part (ops/pm_msr.py) lost exactly one chunk:
+  regenerate it from β-sized GF projections off the healthiest
+  ``d' = 2(d-1)`` helper chunks instead of ``d`` full reads.  Each
+  local/slab helper replica is hash-verified and projected on the
+  shared HostPipeline (the node-side compute of a real deployment —
+  only the β-sized projection enters the repair plane), the combine is
+  one ``[α, d']`` matmul through the part's backend, and the result
+  passes the same end-to-end hash gate.  ``d'·β = 2·chunksize`` repair
+  bytes instead of Reed-Solomon's information-theoretic ``d·chunksize``
+  floor.  Multi-loss, non-local helpers, or any projection shortfall
+  fall through to the decode plan exactly as today.
 * **decode** — no replica of the chunk verifies anywhere: read the same
   damaged ranges from the healthiest ``d`` of the part's other chunks
   (``HealthScoreboard.order`` picks them — never metadata order), feed
   the rebuild matmuls through the shared ``ReconstructBatcher`` (many
   concurrent ranges coalesce into one ``[B, d, S]`` dispatch), splice,
   and rewrite in place.  ``d x damage`` bytes instead of
-  ``d x chunksize``.
+  ``d x chunksize``.  For ``pm-msr`` parts the ranges are whole chunks
+  (byte position t of a stripe belongs to a different codeword than
+  byte t of the chunk, so sub-chunk splicing is rs-only).
 * **fallback** — the planner cannot finish in place (fewer than ``d``
-  healthy helpers, an end-to-end hash failure after rebuild, or a chunk
-  that needs *new* placement): the part is handed back to the caller
-  for the classic full ``resilver`` (which can allocate new locations
-  and republish metadata).
+  healthy helpers, an end-to-end hash failure after rebuild, a chunk
+  that needs *new* placement, or a part declaring a code this build
+  does not implement): the part is handed back to the caller for the
+  classic full ``resilver`` (which can allocate new locations and
+  republish metadata).
+
+Every counter carries the part's ``code`` (closed set ``{rs, pm-msr}``
+— CB107), so ``cb_repair_*``, ``/scrub/status`` and the bench config-13
+A/B read per-code repair traffic from the same numbers.
 
 **Byte metering.**  Every byte the planner touches — victim re-reads
 for localization, helper range reads, repair writes — is charged to the
@@ -66,13 +84,15 @@ dispatch task outlives a pass (the no-leaked-tasks contract,
 
 from __future__ import annotations
 
+import asyncio
 import threading
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Optional
 
 import numpy as np
 
-from chunky_bits_tpu.errors import LocationError
+from chunky_bits_tpu.errors import ErasureError, LocationError
+from chunky_bits_tpu.ops.backend import KNOWN_CODES
 from chunky_bits_tpu.file.location import (
     OVERWRITE,
     Location,
@@ -110,31 +130,52 @@ def merge_ranges(ranges: list[tuple[int, int]]) -> list[tuple[int, int]]:
     return out
 
 
+#: the per-code counter keys (one dict per code in ``RepairStats.
+#: by_code`` and the planner's internals); ``plans_msr`` /
+#: ``helper_bytes_msr`` stay zero for rs parts
+COUNTER_KEYS = ("plans_copy", "plans_decode", "plans_msr",
+                "plans_fallback", "helper_bytes_replica",
+                "helper_bytes_decode", "helper_bytes_msr",
+                "bytes_localized", "bytes_rebuilt", "bytes_written",
+                "ranges_rebuilt", "verify_failures")
+
+#: the closed ``code`` label set (CB107) — the shipped codes, ONE
+#: definition (ops/backend.py): every part the planner touches is
+#: counted under one of these; a foreign/unknown code is clamped to
+#: "rs" on its (only possible) fallback bump
+CODES = KNOWN_CODES
+
+
 @dataclass
 class RepairStats:
     """Counter snapshot: the ``cb_repair_*`` families, the
-    ``/scrub/status`` ``repair`` stanza, and the bench --config 11
-    report are all this one shape."""
+    ``/scrub/status`` ``repair`` stanza, and the bench --config 11/13
+    reports are all this one shape.  Top-level fields are cross-code
+    totals; ``by_code`` carries the same keys per erasure code."""
 
     plans_copy: int
     plans_decode: int
+    plans_msr: int
     plans_fallback: int
     helper_bytes_replica: int
     helper_bytes_decode: int
+    helper_bytes_msr: int
     bytes_localized: int
     bytes_rebuilt: int
     bytes_written: int
     ranges_rebuilt: int
     verify_failures: int
+    by_code: dict = None  # type: ignore[assignment]
 
     def helper_bytes(self) -> int:
-        return self.helper_bytes_replica + self.helper_bytes_decode
+        return (self.helper_bytes_replica + self.helper_bytes_decode
+                + self.helper_bytes_msr)
 
     def savings_ratio(self) -> Optional[float]:
         """Helper bytes read per rebuilt byte — the headline number the
         planner exists to shrink (d for classic decode of whole chunks,
         approaching 1x for copy plans / d x damage for localized
-        decode).  None before any rebuild."""
+        decode / 2x for msr regeneration).  None before any rebuild."""
         if self.bytes_rebuilt <= 0:
             return None
         return self.helper_bytes() / self.bytes_rebuilt
@@ -144,14 +185,18 @@ class RepairStats:
         return {
             "plans_copy": self.plans_copy,
             "plans_decode": self.plans_decode,
+            "plans_msr": self.plans_msr,
             "plans_fallback": self.plans_fallback,
             "helper_bytes_replica": self.helper_bytes_replica,
             "helper_bytes_decode": self.helper_bytes_decode,
+            "helper_bytes_msr": self.helper_bytes_msr,
             "bytes_localized": self.bytes_localized,
             "bytes_rebuilt": self.bytes_rebuilt,
             "bytes_written": self.bytes_written,
             "ranges_rebuilt": self.ranges_rebuilt,
             "verify_failures": self.verify_failures,
+            "by_code": {code: dict(counters)
+                        for code, counters in (self.by_code or {}).items()},
             **({"helper_bytes_per_rebuilt_byte": round(ratio, 4)}
                if ratio is not None else {}),
         }
@@ -186,18 +231,11 @@ class RepairPlanner:
         self.bucket = bucket if bucket is not None else TokenBucket(0.0)
         self.backend = backend
         # counters are read by /metrics scrapes and /scrub/status
-        # handlers, possibly from other threads than the repair loop's
+        # handlers, possibly from other threads than the repair loop's;
+        # one dict per code so every family carries the code label
         self._lock = threading.Lock()
-        self._plans_copy = 0
-        self._plans_decode = 0
-        self._plans_fallback = 0
-        self._helper_bytes_replica = 0
-        self._helper_bytes_decode = 0
-        self._bytes_localized = 0
-        self._bytes_rebuilt = 0
-        self._bytes_written = 0
-        self._ranges_rebuilt = 0
-        self._verify_failures = 0
+        self._counters = {code: dict.fromkeys(COUNTER_KEYS, 0)
+                          for code in CODES}
         # weakly self-register with the process metrics registry so a
         # /metrics scrape reports repair progress (same pattern as the
         # scrub daemon and the health scoreboard)
@@ -207,25 +245,20 @@ class RepairPlanner:
 
     # ---- reporting ----
 
-    def _bump(self, **deltas: int) -> None:
+    def _bump(self, code: str, **deltas: int) -> None:
+        counters = self._counters[code if code in self._counters
+                                  else "rs"]
         with self._lock:
             for key, delta in deltas.items():
-                setattr(self, f"_{key}", getattr(self, f"_{key}") + delta)
+                counters[key] += delta
 
     def stats(self) -> RepairStats:
         with self._lock:
-            return RepairStats(
-                plans_copy=self._plans_copy,
-                plans_decode=self._plans_decode,
-                plans_fallback=self._plans_fallback,
-                helper_bytes_replica=self._helper_bytes_replica,
-                helper_bytes_decode=self._helper_bytes_decode,
-                bytes_localized=self._bytes_localized,
-                bytes_rebuilt=self._bytes_rebuilt,
-                bytes_written=self._bytes_written,
-                ranges_rebuilt=self._ranges_rebuilt,
-                verify_failures=self._verify_failures,
-            )
+            by_code = {code: dict(counters)
+                       for code, counters in self._counters.items()}
+        totals = {key: sum(c[key] for c in by_code.values())
+                  for key in COUNTER_KEYS}
+        return RepairStats(by_code=by_code, **totals)
 
     # ---- shared plumbing ----
 
@@ -266,7 +299,8 @@ class RepairPlanner:
     async def _localize(self, ci: int, chunk: "Chunk", chunksize: int,
                         corrupt: list[Location], cx: LocationContext,
                         pipe: "HostPipeline",
-                        payloads: Optional[dict] = None
+                        payloads: Optional[dict] = None,
+                        code: str = "rs"
                         ) -> tuple[Optional[bytearray],
                                    list[tuple[int, int]]]:
         """(base bytes to splice into, damaged ranges) for one damaged
@@ -288,7 +322,7 @@ class RepairPlanner:
                     base = await self._read_full(location, cx)
                 except LocationError:
                     continue
-                self._bump(bytes_localized=len(base))
+                self._bump(code, bytes_localized=len(base))
             blocks = chunk.blocks
             ranges = await pipe.run(
                 "verify",
@@ -301,20 +335,21 @@ class RepairPlanner:
             return None, whole
         return None, whole
 
-    async def _verify_full(self, chunk: "Chunk", buf, pipe: "HostPipeline"
-                           ) -> bool:
+    async def _verify_full(self, chunk: "Chunk", buf, pipe: "HostPipeline",
+                           code: str = "rs") -> bool:
         """The end-to-end gate: the spliced chunk must match its
         content hash before any write."""
         ok = await pipe.run(
             "verify", lambda: chunk.hash.verify(bytes(buf)),
             nbytes=len(buf))
         if not ok:
-            self._bump(verify_failures=1)
+            self._bump(code, verify_failures=1)
         return bool(ok)
 
     async def _write_victims(self, chunk: "Chunk", payload: bytes,
                              victims: list[Location],
-                             cx: LocationContext) -> tuple[int, int]:
+                             cx: LocationContext,
+                             code: str = "rs") -> tuple[int, int]:
         """Rewrite ``victims`` in place with verified bytes (metered);
         returns (repaired, failures).  Content-addressed overwrite is
         always safe — the same rationale as resilver's overwrite
@@ -329,7 +364,7 @@ class RepairPlanner:
                 # node still down/full: counted, retried next pass
                 failures += 1
                 continue
-            self._bump(bytes_written=len(payload))
+            self._bump(code, bytes_written=len(payload))
             repaired += 1
         return repaired, failures
 
@@ -339,14 +374,15 @@ class RepairPlanner:
                          good: list[Location], corrupt: list[Location],
                          missing: list[Location], cx: LocationContext,
                          pipe: "HostPipeline",
-                         payloads: Optional[dict] = None
+                         payloads: Optional[dict] = None,
+                         code: str = "rs"
                          ) -> tuple[int, int]:
         """1x repair from a healthy replica: ranged reads for localized
         corrupt victims, one whole-chunk read (cached across victims)
         for the rest.  Sources fail over best-health-first — a replica
         that verified a moment ago may be gone by repair time, and the
         next one serves the same bytes.  Returns (repaired, failures)."""
-        self._bump(plans_copy=1)
+        self._bump(code, plans_copy=1)
         sources = self._order(good)
         repaired = failures = 0
         full: Optional[bytes] = None  # whole-source cache
@@ -359,8 +395,9 @@ class RepairPlanner:
                         data = await self._read_full(source, cx)
                     except LocationError:
                         continue  # replica vanished: next-best source
-                    self._bump(helper_bytes_replica=len(data))
-                    if not await self._verify_full(chunk, data, pipe):
+                    self._bump(code, helper_bytes_replica=len(data))
+                    if not await self._verify_full(chunk, data, pipe,
+                                                   code):
                         continue  # raced a writer; try another replica
                     full = data
                     break
@@ -374,7 +411,7 @@ class RepairPlanner:
                                                  cx)
                 except LocationError:
                     continue
-                self._bump(helper_bytes_replica=length)
+                self._bump(code, helper_bytes_replica=length)
                 return seg
             return None
 
@@ -382,7 +419,8 @@ class RepairPlanner:
             spliced = False
             if chunk.blocks is not None and full is None:
                 base, ranges = await self._localize(
-                    ci, chunk, chunksize, [victim], cx, pipe, payloads)
+                    ci, chunk, chunksize, [victim], cx, pipe, payloads,
+                    code)
                 if base is not None:
                     buf, ok = bytearray(base), True
                     for start, length in ranges:
@@ -391,11 +429,12 @@ class RepairPlanner:
                             ok = False
                             break
                         buf[start: start + length] = seg
-                    if ok and await self._verify_full(chunk, buf, pipe):
+                    if ok and await self._verify_full(chunk, buf, pipe,
+                                                      code):
                         r, f = await self._write_victims(
-                            chunk, bytes(buf), [victim], cx)
+                            chunk, bytes(buf), [victim], cx, code)
                         if r:
-                            self._bump(bytes_rebuilt=sum(
+                            self._bump(code, bytes_rebuilt=sum(
                                 ln for _s, ln in ranges),
                                 ranges_rebuilt=len(ranges))
                         repaired += r
@@ -407,9 +446,11 @@ class RepairPlanner:
             if payload is None:
                 failures += 1
                 continue
-            r, f = await self._write_victims(chunk, payload, [victim], cx)
+            r, f = await self._write_victims(chunk, payload, [victim],
+                                             cx, code)
             if r:
-                self._bump(bytes_rebuilt=len(payload), ranges_rebuilt=1)
+                self._bump(code, bytes_rebuilt=len(payload),
+                           ranges_rebuilt=1)
             repaired += r
             failures += f
         for victim in missing:
@@ -417,9 +458,11 @@ class RepairPlanner:
             if payload is None:
                 failures += 1
                 continue
-            r, f = await self._write_victims(chunk, payload, [victim], cx)
+            r, f = await self._write_victims(chunk, payload, [victim],
+                                             cx, code)
             if r:
-                self._bump(bytes_rebuilt=len(payload), ranges_rebuilt=1)
+                self._bump(code, bytes_rebuilt=len(payload),
+                           ranges_rebuilt=1)
             repaired += r
             failures += f
         return repaired, failures
@@ -427,7 +470,8 @@ class RepairPlanner:
     async def _read_helper_range(self, ci: int, chunk: "Chunk",
                                  location: Location, start: int,
                                  length: int, cx: LocationContext,
-                                 pipe: "HostPipeline") -> bytes:
+                                 pipe: "HostPipeline",
+                                 code: str = "rs") -> bytes:
         """One helper's contribution to a decode range: metered, and
         pre-checked against the helper's own block digests when the
         range aligns to its grid (a corrupt helper fails here instead
@@ -444,7 +488,7 @@ class RepairPlanner:
                     self.health.record(location, False)
                 raise LocationError(
                     f"helper block digest mismatch at {location}")
-        self._bump(helper_bytes_decode=length)
+        self._bump(code, helper_bytes_decode=length)
         return data
 
     async def _decode_ranges(self, part: "FilePart",
@@ -460,6 +504,7 @@ class RepairPlanner:
         range cannot gather ``d`` helpers."""
         chunks = part.all_chunks()
         d, p = len(part.data), len(part.parity)
+        code = part.code
 
         async def one(start: int, length: int) -> Optional[tuple]:
             slots: list = [None] * (d + p)
@@ -470,7 +515,7 @@ class RepairPlanner:
                 try:
                     data = await self._read_helper_range(
                         ci, chunks[ci], location, start, length, cx,
-                        pipe)
+                        pipe, code)
                 except LocationError:
                     continue
                 slots[ci] = np.frombuffer(data, dtype=np.uint8)
@@ -478,7 +523,8 @@ class RepairPlanner:
             if got < d:
                 return None  # not enough live helpers for this range
             arrays = await batcher.reconstruct(d, p, slots,
-                                               data_only=False)
+                                               data_only=False,
+                                               code=code)
             rebuilt = {
                 ci: np.ascontiguousarray(arr).tobytes()
                 for ci, arr in enumerate(arrays)
@@ -491,6 +537,128 @@ class RepairPlanner:
         if any(res is None for res in results):
             return None
         return {start: rebuilt for start, rebuilt in results}
+
+    async def _helper_projection(self, ci: int, chunk: "Chunk",
+                                 locations: list[Location], coder,
+                                 chunksize: int, cx: LocationContext,
+                                 pipe: "HostPipeline"
+                                 ) -> Optional[np.ndarray]:
+        """One helper's β-sized contribution to regenerating chunk
+        ``ci``: read a verified replica and project its α stripes
+        through ``φ_ci`` on the shared HostPipeline — the node-side
+        compute of a real MSR deployment, where only the projection
+        crosses the network.  The scrub bucket is charged the FULL
+        replica read BEFORE the I/O: the byte-rate bound exists to
+        protect foreground traffic on the disks this process actually
+        touches, and computing a local projection reads chunksize even
+        though only β enters the repair plane (``helper_bytes_msr``
+        records β — the network bytes a distributed deployment would
+        move — while the bucket meters the disk).  Failing/corrupt
+        replicas fail over best-health-first; corrupt content demerits
+        the serving node.  Returns the ``[β]`` projection, or None when
+        no replica verifies (the caller drops this helper)."""
+        for location in locations:
+            await self.bucket.take(chunksize)
+            try:
+                data = await location.read(cx)
+            except LocationError:
+                continue
+            if len(data) != chunksize:
+                continue  # truncated replica cannot project soundly
+            ok = await pipe.run(
+                "verify", lambda data=data: chunk.hash.verify(data),
+                nbytes=len(data))
+            if not ok:
+                # a lying helper would survive to the end-to-end gate
+                # anyway, but catching it here costs one hash and saves
+                # the whole plan
+                if self.health is not None:
+                    self.health.record(location, False)
+                continue
+            arr = np.frombuffer(data, dtype=np.uint8)[None, :]
+            return await pipe.run(
+                "encode",
+                lambda arr=arr: coder.project_batch(ci, arr)[0],
+                nbytes=chunksize)
+        return None
+
+    async def _msr_plan(self, part: "FilePart", ci: int,
+                        chunks: list["Chunk"], good: list[list[Location]],
+                        victims: list[Location], cx: LocationContext,
+                        pipe: "HostPipeline"
+                        ) -> Optional[tuple[int, int]]:
+        """Regenerate the single lost chunk ``ci`` of a ``pm-msr`` part
+        from ``d' = 2(d-1)`` helper projections (module docstring, plan
+        kind **msr**): ``d'·β = 2·chunksize`` repair-plane bytes
+        instead of the decode plan's ``d·chunksize``.  Helpers are the
+        healthiest chunks with verified local/slab replicas; the
+        rebuilt chunk passes the full content-hash gate before any
+        write.  Returns (repaired, failures), or None when the plan
+        cannot run/finish — the caller falls through to the classic
+        decode plan, so an aborted msr attempt costs at most a few β
+        reads, never correctness."""
+        from chunky_bits_tpu.ops.backend import get_coder
+
+        try:
+            coder = await asyncio.to_thread(
+                get_coder, len(part.data), len(part.parity),
+                self.backend, "pm-msr")
+        except ErasureError:
+            return None  # geometry this code cannot run (foreign ref)
+        if part.chunksize <= 0 or part.chunksize % coder.alpha:
+            return None
+        beta = part.chunksize // coder.alpha
+        candidates: list[tuple[int, list[Location]]] = []
+        for hi in range(len(chunks)):
+            if hi == ci or not good[hi]:
+                continue
+            locs = [loc for loc in self._order(good[hi])
+                    if loc.is_local() or loc.is_slab()]
+            if locs:
+                candidates.append((hi, locs))
+        if len(candidates) < coder.helpers:
+            return None
+        # healthiest-first helper order: rank each candidate chunk by
+        # its best replica through the scoreboard (same shape as the
+        # decode plan's helper ordering)
+        by_loc = {id(locs[0]): (hi, locs) for hi, locs in candidates}
+        ordered = [by_loc[id(loc)] for loc in
+                   self._order([locs[0] for _hi, locs in candidates])]
+        used: list[int] = []
+        projections: list[np.ndarray] = []
+        for hi, locs in ordered:
+            if len(used) >= coder.helpers:
+                break
+            proj = await self._helper_projection(
+                ci, chunks[hi], locs, coder, part.chunksize, cx, pipe)
+            if proj is None:
+                continue
+            used.append(hi)
+            projections.append(proj)
+            self._bump("pm-msr", helper_bytes_msr=beta)
+        if len(used) < coder.helpers:
+            return None  # helpers vanished since verify: decode decides
+        stacked = np.ascontiguousarray(np.stack(projections))[None, ...]
+        try:
+            rebuilt = await pipe.run(
+                "encode",
+                lambda: coder.repair_batch(ci, used, stacked)[0],
+                nbytes=part.chunksize)
+        except ErasureError:
+            return None
+        payload = np.ascontiguousarray(rebuilt).tobytes()
+        if not await self._verify_full(chunks[ci], payload, pipe,
+                                       "pm-msr"):
+            # helpers inconsistent with this chunk's hash (stale ref,
+            # raced writer): the decode plan re-reads and decides
+            return None
+        self._bump("pm-msr", plans_msr=1)
+        r, f = await self._write_victims(chunks[ci], payload, victims,
+                                         cx, "pm-msr")
+        if r:
+            self._bump("pm-msr", bytes_rebuilt=part.chunksize,
+                       ranges_rebuilt=1)
+        return (r, f)
 
     # ---- the entry point ----
 
@@ -510,8 +678,18 @@ class RepairPlanner:
         nothing (see :meth:`_localize`)."""
         chunks = part.all_chunks()
         d = len(part.data)
+        code = part.code
         repaired = failures = 0
         fallback = False
+
+        if code not in KNOWN_CODES:
+            # a part declaring a code this build does not implement:
+            # even copy plans stay hands-off (the bytes' semantics are
+            # a newer writer's) — hand it straight to resilver, whose
+            # own require_known_code reports it cleanly.  Counted under
+            # the clamped "rs" label (the closed-set rule).
+            self._bump("rs", plans_fallback=1)
+            return PartRepairOutcome(repaired, failures, True)
 
         good: list[list[Location]] = []
         corrupt: list[list[Location]] = []
@@ -525,28 +703,43 @@ class RepairPlanner:
             # a chunk with no replicas at all needs NEW placement —
             # resilver's job (get_used_writers), not an in-place plan
             fallback = True
-            self._bump(plans_fallback=1)
+            self._bump(code, plans_fallback=1)
 
         # 1. copy plans: damaged replicas beside a healthy one
         for ci, chunk in enumerate(chunks):
             if good[ci] and (corrupt[ci] or missing[ci]):
                 r, f = await self._copy_plan(
                     ci, chunk, part.chunksize, good[ci], corrupt[ci],
-                    missing[ci], cx, pipe, payloads)
+                    missing[ci], cx, pipe, payloads, code)
                 repaired += r
                 failures += f
 
-        # 2. decode plans: chunks with no verified replica anywhere
+        # 2. chunks with no verified replica anywhere
         lost = [ci for ci in range(len(chunks))
                 if not good[ci] and (corrupt[ci] or missing[ci])]
         if not lost:
             return PartRepairOutcome(repaired, failures, fallback)
+
+        # 2a. msr regeneration: a pm-msr part that lost exactly ONE
+        # chunk rebuilds from d' β-sized helper projections (2x
+        # chunksize of repair-plane bytes instead of decode's d x);
+        # any shortfall falls through to the decode plan below
+        if code == "pm-msr" and len(lost) == 1:
+            res = await self._msr_plan(
+                part, lost[0], chunks, good,
+                corrupt[lost[0]] + missing[lost[0]], cx, pipe)
+            if res is not None:
+                repaired += res[0]
+                failures += res[1]
+                return PartRepairOutcome(repaired, failures, fallback)
+
+        # 2b. decode plans
         helper_pool = [(ci, self._order(good[ci])[0])
                        for ci in range(len(chunks)) if good[ci]]
         if len(helper_pool) < d:
             # unrecoverable in place AND by resilver; hand it back so
             # the classic path reports it (legacy failure accounting)
-            self._bump(plans_fallback=1)
+            self._bump(code, plans_fallback=1)
             return PartRepairOutcome(repaired, failures, True)
         # healthiest-first helper order: sort the candidate locations
         # through the scoreboard, then map back to (chunk, location)
@@ -554,13 +747,20 @@ class RepairPlanner:
         helpers = [by_loc[id(loc)] for loc in
                    self._order([loc for _ci, loc in helper_pool])]
 
-        self._bump(plans_decode=1)
+        self._bump(code, plans_decode=1)
         bases: dict[int, Optional[bytearray]] = {}
         ranges_by_ci: dict[int, list[tuple[int, int]]] = {}
         for ci in lost:
+            if code == "pm-msr":
+                # stripe-structured code: byte t of the chunk is not
+                # byte t of one codeword, so decode works at whole-chunk
+                # granularity (block trees still localize COPY plans)
+                bases[ci] = None
+                ranges_by_ci[ci] = [(0, part.chunksize)]
+                continue
             base, ranges = await self._localize(
                 ci, chunks[ci], part.chunksize, corrupt[ci], cx, pipe,
-                payloads)
+                payloads, code)
             bases[ci] = base
             ranges_by_ci[ci] = ranges
         union = merge_ranges(
@@ -572,10 +772,15 @@ class RepairPlanner:
         try:
             rebuilt = await self._decode_ranges(
                 part, helpers, union, cx, pipe, batcher)
+        except ErasureError:
+            # a geometry/shape the codec refuses (e.g. a handcrafted
+            # pm-msr ref whose geometry the code cannot run): the
+            # classic resilver reports it in its own words
+            rebuilt = None
         finally:
             await batcher.aclose()
         if rebuilt is None:
-            self._bump(plans_fallback=1)
+            self._bump(code, plans_fallback=1)
             return PartRepairOutcome(repaired, failures, True)
 
         for ci in lost:
@@ -591,22 +796,23 @@ class RepairPlanner:
                 buf[start: start + length] = seg
                 spliced += 1
             if spliced < 0 or not await self._verify_full(
-                    chunks[ci], buf, pipe):
+                    chunks[ci], buf, pipe, code):
                 # helpers inconsistent with this chunk's hash (stale
                 # tree, raced writer): the full resilver re-reads
                 # everything and decides
                 fallback = True
-                self._bump(plans_fallback=1)
+                self._bump(code, plans_fallback=1)
                 continue
             victims = corrupt[ci] + missing[ci]
             if not victims:
                 fallback = True  # needs NEW placement: resilver's job
-                self._bump(plans_fallback=1)
+                self._bump(code, plans_fallback=1)
                 continue
             r, f = await self._write_victims(chunks[ci], bytes(buf),
-                                             victims, cx)
+                                             victims, cx, code)
             if r:
                 self._bump(
+                    code,
                     bytes_rebuilt=sum(ln for _s, ln in
                                       ranges_by_ci[ci]),
                     ranges_rebuilt=len(ranges_by_ci[ci]))

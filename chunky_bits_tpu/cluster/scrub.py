@@ -141,8 +141,10 @@ class ScrubStats:
                 if self.rate_bytes_per_sec > 0 else "unbounded")
         plans = ""
         if self.repair is not None:
+            # c=copy d=decode m=msr(pm-msr regeneration) f=fallback
             plans = (f" plans={self.repair.get('plans_copy', 0)}c/"
                      f"{self.repair.get('plans_decode', 0)}d/"
+                     f"{self.repair.get('plans_msr', 0)}m/"
                      f"{self.repair.get('plans_fallback', 0)}f")
             ratio = self.repair.get("helper_bytes_per_rebuilt_byte")
             if ratio is not None:

@@ -131,6 +131,20 @@ TRACE_SLOW_MS_ENV = "CHUNKY_BITS_TPU_TRACE_SLOW_MS"
 #: (like every routing flag: set it before the first encode).
 XOR_SCHEDULE_ENV = "CHUNKY_BITS_TPU_XOR_SCHEDULE"
 
+#: default erasure code for write profiles that do not pin one in YAML
+#: (cluster/profile.py ``code`` key; file/writer.py FileWriteBuilder):
+#: "rs" (classic Reed-Solomon — the default) or "pm-msr" (product-
+#: matrix MSR regenerating code, ops/pm_msr.py — single-chunk repair
+#: from 2(d-1) helper projections at ~2x chunk bytes instead of d x).
+#: A DEFAULT, not a force: profiles whose geometry cannot run pm-msr
+#: (parity < data-1, alpha-indivisible chunk size) stay rs, so a
+#: fleet-wide env flip — the CI pm-msr matrix leg — never breaks
+#: incompatible profiles; explicit YAML ``code:`` wins both ways and
+#: validates loudly.  Read when a write profile resolves its code
+#: (cluster profile access / writer build) — per the
+#: read-at-first-dispatch contract, set it before the first write.
+CODE_ENV = "CHUNKY_BITS_TPU_CODE"
+
 #: opt-in runtime concurrency sanitizer (analysis/sanitizer.py):
 #: event-loop stall watchdog, task-leak registry, host-pipeline handoff
 #: checks.  Off by default (and force-disabled by bench.py — the
@@ -224,6 +238,20 @@ def xor_schedule_enabled(*, default: bool = False) -> bool:
     moves compute between engines, so it parses as a standard flag and
     is read at first dispatch (baked per backend instance)."""
     return env_flag(XOR_SCHEDULE_ENV, default=default)
+
+
+def erasure_code(*, default: str = "rs") -> str:
+    """Requested default erasure code from ``$CHUNKY_BITS_TPU_CODE``
+    for write profiles that do not pin ``code:`` in YAML.  Lenient like
+    every perf knob — an unknown value reads as ``default`` (the knob
+    can only *select between shipped codes*, never crash config
+    loading); geometry compatibility is the caller's check
+    (cluster/profile.py resolves to "rs" when the profile cannot run
+    the requested code)."""
+    from chunky_bits_tpu.ops.backend import KNOWN_CODES
+
+    raw = os.environ.get(CODE_ENV, "").strip()
+    return raw if raw in KNOWN_CODES else default
 
 
 def gateway_workers(*, default: int = 1) -> int:

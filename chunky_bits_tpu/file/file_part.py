@@ -23,6 +23,7 @@ from typing import TYPE_CHECKING, Any, Iterator, Optional
 import numpy as np
 
 from chunky_bits_tpu.errors import (
+    FileReadError,
     FileWriteError,
     LocationError,
     NotEnoughChunks,
@@ -183,26 +184,35 @@ async def _read_chunk_payload(location: Location, cx: LocationContext
 async def _reconstruct(arrays: list[Optional[np.ndarray]], d: int, p: int,
                        coder: Optional[ErasureCoder], backend: Optional[str],
                        batcher: Optional[ReconstructBatcher],
-                       data_only: bool) -> list[Optional[np.ndarray]]:
+                       data_only: bool,
+                       code: str = "rs") -> list[Optional[np.ndarray]]:
     """Fill the ``None`` rows of ``arrays``: through the shared batcher
     when one is wired in (coalesced device dispatches), else via a lazily
     resolved coder off-loop — constructing a device backend (jax init) can
     take seconds and must neither block the event loop nor run on healthy
-    reads."""
+    reads.  ``code`` is the part's wire-format erasure code; an injected
+    ``coder`` must already match it (the write path injects its own)."""
     if batcher is not None:
-        return await batcher.reconstruct(d, p, arrays, data_only=data_only)
+        return await batcher.reconstruct(d, p, arrays, data_only=data_only,
+                                         code=code)
     if coder is None:
-        coder = await asyncio.to_thread(get_coder, d, p, backend)
+        coder = await asyncio.to_thread(get_coder, d, p, backend, code)
     fn = coder.reconstruct_data if data_only else coder.reconstruct
     return await asyncio.to_thread(fn, arrays)
 
 
-def split_into_shards(data_buf: BufferLike, length: int, d: int
+def split_into_shards(data_buf: BufferLike, length: int, d: int,
+                      shard_len: Optional[int] = None
                       ) -> tuple[list[memoryview], int]:
     """Split ``length`` meaningful bytes (backed by a zero-padded buffer)
     into d equal shards of ceil(length/d) bytes — the reference's round-up
-    split (src/file/file_part.rs:150-158).  Returns (shards, shard_len)."""
-    buf_length = (length + d - 1) // d if length > 0 else 0
+    split (src/file/file_part.rs:150-158).  Returns (shards, shard_len).
+
+    ``shard_len`` overrides the default round-up (sub-symbol codes round
+    further so each shard divides into equal stripes; the extra tail is
+    zero-padded exactly like the classic split's)."""
+    buf_length = (shard_len if shard_len is not None
+                  else (length + d - 1) // d if length > 0 else 0)
     view = memoryview(data_buf)
     if len(view) < buf_length * d:
         padded = bytearray(buf_length * d)
@@ -218,6 +228,14 @@ class FilePart:
     data: list[Chunk]
     parity: list[Chunk] = field(default_factory=list)
     encryption: Optional[str] = None
+    #: erasure code of this part's stripe — "rs" (classic Reed-Solomon,
+    #: the only value old references carry; the key is omitted on the
+    #: wire so rs refs stay byte-identical to pre-code writers) or
+    #: "pm-msr" (ops/pm_msr.py).  Values outside ops.backend.KNOWN_CODES
+    #: parse fine but degrade every codec-touching operation (read,
+    #: resilver, repair) to a clean FileReadError — a foreign code could
+    #: be non-systematic, so even a healthy read must refuse to guess.
+    code: str = "rs"
 
     def len_bytes(self) -> int:
         return self.chunksize * len(self.data)
@@ -228,6 +246,10 @@ class FilePart:
         obj: dict = {}
         if self.encryption is not None:
             obj["encryption"] = self.encryption
+        if self.code != "rs":
+            # strictly additive: rs parts serialize without the key,
+            # byte-identical to references written before this field
+            obj["code"] = self.code
         obj["chunksize"] = self.chunksize
         obj["data"] = [c.to_obj() for c in self.data]
         if self.parity:
@@ -241,7 +263,23 @@ class FilePart:
             data=[Chunk.from_obj(c) for c in obj["data"]],
             parity=[Chunk.from_obj(c) for c in obj.get("parity", [])],
             encryption=obj.get("encryption"),
+            # an explicit ``code: null`` means unset, like an absent
+            # key — never the string "None" (which would brick reads)
+            code=str(obj.get("code") or "rs"),
         )
+
+    def require_known_code(self) -> None:
+        """Raise the clean per-part gate for codec-touching paths: a
+        part declaring a code this reader does not implement must fail
+        as a read error (the CLI and gateway report it per file), never
+        crash or silently concatenate chunks of unknown semantics."""
+        from chunky_bits_tpu.ops.backend import KNOWN_CODES
+
+        if self.code not in KNOWN_CODES:
+            raise FileReadError(
+                f"part uses unknown erasure code {self.code!r} "
+                f"(this reader knows {', '.join(KNOWN_CODES)}; "
+                f"a newer writer produced this reference)")
 
     def all_chunks(self) -> list[Chunk]:
         return list(self.data) + list(self.parity)
@@ -284,6 +322,7 @@ class FilePart:
         through the cache's singleflight (concurrent readers of one
         digest share a single fetch), and whole verified buffers —
         never trimmed ranges — are what gets inserted."""
+        self.require_known_code()
         cx = cx or default_context()
         pipe = _pipe(pipeline)
         if cx.profiler is not None:
@@ -605,7 +644,8 @@ class FilePart:
             ]
             t0 = time.monotonic()
             arrays = await _reconstruct(arrays, d, p, coder, backend,
-                                        batcher, data_only=True)
+                                        batcher, data_only=True,
+                                        code=self.code)
             obs_tracing.record_span("reconstruct", "compute", t0,
                                     time.monotonic() - t0)
             # rebuilt rows stay as buffers (memoryview over the array) —
@@ -632,7 +672,8 @@ class FilePart:
         """Split + parity computation (src/file/file_part.rs:150-165).
         Pure so batching layers can aggregate parts into one dispatch."""
         d = coder.data
-        shards, buf_length = split_into_shards(data_buf, length, d)
+        shards, buf_length = split_into_shards(
+            data_buf, length, d, shard_len=coder.shard_len(length))
         if buf_length == 0:
             return shards, [], 0
         stacked = np.stack(
@@ -728,6 +769,7 @@ class FilePart:
             chunksize=buf_length,
             data=list(chunks[:d]),
             parity=list(chunks[d:]),
+            code=coder.code,
         )
 
     # ---- verify (src/file/file_part.rs:228-251) ----
@@ -786,6 +828,7 @@ class FilePart:
         # shard lands on the node already holding it (write_subfile sees the
         # file exists and skips); overwriting a content-addressed chunk with
         # bytes matching its hash is always safe.
+        self.require_known_code()
         overwrite = getattr(destination, "with_conflict_overwrite", None)
         if overwrite is not None:
             destination = overwrite()
@@ -833,7 +876,8 @@ class FilePart:
                     for b in data_bufs
                 ]
                 arrays = await _reconstruct(arrays, d, p, coder, backend,
-                                            batcher, data_only=False)
+                                            batcher, data_only=False,
+                                            code=self.code)
                 rebuilt: list[Optional[bytes]] = [
                     a.tobytes() if isinstance(a, np.ndarray) else None
                     for a in arrays

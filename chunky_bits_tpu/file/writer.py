@@ -83,6 +83,12 @@ class FileWriteBuilder:
     #: their metadata for damage localization (cluster/repair.py);
     #: 0 = off
     repair_block_bytes: int = 0
+    #: erasure code for every part this writer emits: "rs" (classic
+    #: Reed-Solomon, the default — refs stay byte-identical to older
+    #: writers) or "pm-msr" (product-matrix MSR regenerating code,
+    #: ops/pm_msr.py; needs parity >= data-1 and an alpha-divisible
+    #: chunk size).  Cluster profiles route their ``code`` knob here.
+    code: str = "rs"
 
     # builder setters (writer.rs:78-110); return copies like the Rust
     # builder's consume-and-return
@@ -125,13 +131,25 @@ class FileWriteBuilder:
                                 ) -> "FileWriteBuilder":
         return replace(self, repair_block_bytes=repair_block_bytes)
 
+    def with_code(self, code: str) -> "FileWriteBuilder":
+        return replace(self, code=code)
+
     async def write(self, reader: aio.AsyncByteReader) -> FileReference:
         if self.concurrency <= 1:
             raise FileWriteError("concurrency must be > 1")
         batch_parts = max(1, min(self.batch_parts, self.concurrency))
         stage_size = max(1, min(batch_parts, self.stage_parts))
         d, p = self.data, self.parity
-        coder = get_coder(d, p, self.backend)
+        # raises ErasureError on an unknown code or a geometry the code
+        # cannot run (e.g. pm-msr with parity < data-1) — a writer must
+        # fail loudly at the first part, not emit an unreadable ref
+        coder = get_coder(d, p, self.backend, self.code)
+        if coder.shard_len(d * self.chunk_size) != self.chunk_size:
+            raise FileWriteError(
+                f"chunk_size {self.chunk_size} incompatible with "
+                f"code {coder.code!r}: full-length shards must not "
+                f"need sub-symbol padding (pm-msr: chunk_size % "
+                f"alpha == 0, alpha = data-1)")
         from chunky_bits_tpu.file.collection_destination import \
             as_destination
         from chunky_bits_tpu.parallel.host_pipeline import get_host_pipeline
@@ -199,7 +217,7 @@ class FileWriteBuilder:
             thread for the repack memcpy."""
             groups: dict[int, list[int]] = {}
             for i, length in enumerate(ls):
-                shard_len = (length + d - 1) // d
+                shard_len = coder.shard_len(length)
                 groups.setdefault(shard_len, []).append(i)
             staged_groups = []
             for shard_len, indices in groups.items():
@@ -247,7 +265,8 @@ class FileWriteBuilder:
                     return
                 if encode_batcher is not None:
                     parity_batch, digest_batch = \
-                        await encode_batcher.encode_hash(d, p, stacked)
+                        await encode_batcher.encode_hash(
+                            d, p, stacked, code=coder.code)
                 else:
                     parity_batch, digest_batch = \
                         await pipeline.encode_hash(coder, stacked)

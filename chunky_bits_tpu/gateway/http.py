@@ -256,12 +256,20 @@ def _covering_chunk(file_ref: FileReference, seek: int, length: int):
     precondition for serving it straight off a local chunk file — else
     None.  Parity chunks never qualify (their bytes are not file
     bytes), nor do spans crossing a chunk or part boundary."""
+    from chunky_bits_tpu.ops.backend import KNOWN_CODES
+
     part_off = 0
     for part in file_ref.parts:
         part_len = part.len_bytes()
         if seek < part_off + part_len:
             if seek + length > part_off + part_len:
                 return None  # spans parts
+            if part.code not in KNOWN_CODES:
+                # a foreign code could be non-systematic — raw chunk
+                # bytes may not be file bytes, so the generic path must
+                # raise its clean per-part error instead of sendfile
+                # serving a guess (file_part.require_known_code)
+                return None
             local = seek - part_off
             csize = part.chunksize
             if csize <= 0:

@@ -256,9 +256,9 @@ class MetricsRegistry:
 
     def register_source(self, kind: str, obj: object) -> None:
         """Weakly register a stat source (``kind`` one of "cache",
-        "pipeline", "health", "scrub", "repair"); its ``stats()``
-        snapshot is folded into every registry snapshot while the
-        object lives.
+        "pipeline", "health", "scrub", "repair", "xor_schedule"); its
+        ``stats()`` (``info()`` for the xor-schedule cache) snapshot is
+        folded into every registry snapshot while the object lives.
         Registration never extends the object's lifetime, so per-loop
         caches and sweep-pinned pipelines drop out with their owners."""
         with self._lock:
@@ -499,28 +499,33 @@ def _source_families(reg: MetricsRegistry) -> list[dict]:
 
     repairs = [r.stats().to_obj() for r in reg._live_sources("repair")]
     if repairs:
-        s = _sum_rows(repairs, ("plans_copy", "plans_decode",
-                                "plans_fallback",
-                                "helper_bytes_replica",
-                                "helper_bytes_decode",
-                                "bytes_localized", "bytes_rebuilt",
-                                "bytes_written", "ranges_rebuilt",
-                                "verify_failures"))
-        # plan kinds and helper-read sources are CLOSED label sets
-        # (CB107): copy = 1x from a healthy replica, decode = ranged
-        # reads off d helpers, fallback = handed to full resilver
+        # plan kinds, helper-read sources AND erasure codes are CLOSED
+        # label sets (CB107): copy = 1x from a healthy replica, decode
+        # = ranged reads off d helpers, msr = pm-msr β-projection
+        # regeneration, fallback = handed to full resilver; codes come
+        # from cluster.repair.CODES
+        by_code: dict[str, dict] = {}
+        for r in repairs:
+            for code, counters in (r.get("by_code") or {}).items():
+                agg = by_code.setdefault(code, {})
+                for key, value in counters.items():
+                    agg[key] = agg.get(key, 0.0) + float(value or 0)
+        codes = sorted(by_code)
         fams.append(_fam("cb_repair_plans_total", COUNTER,
-                         "repair plans executed by kind", [
-                             _scalar(s["plans_copy"], kind="copy"),
-                             _scalar(s["plans_decode"], kind="decode"),
-                             _scalar(s["plans_fallback"],
-                                     kind="fallback")]))
+                         "repair plans executed by kind and code", [
+                             _scalar(by_code[c].get(f"plans_{kind}", 0),
+                                     kind=kind, code=c)
+                             for c in codes
+                             for kind in ("copy", "decode", "msr",
+                                          "fallback")]))
         fams.append(_fam("cb_repair_helper_bytes_total", COUNTER,
-                         "bytes read off helpers for repair by source",
-                         [_scalar(s["helper_bytes_replica"],
-                                  source="replica"),
-                          _scalar(s["helper_bytes_decode"],
-                                  source="decode")]))
+                         "bytes read off helpers for repair by source "
+                         "and code", [
+                             _scalar(by_code[c].get(
+                                 f"helper_bytes_{src}", 0),
+                                 source=src, code=c)
+                             for c in codes
+                             for src in ("replica", "decode", "msr")]))
         for key, help_ in (
                 ("bytes_localized",
                  "victim bytes re-read to localize damage"),
@@ -531,7 +536,8 @@ def _source_families(reg: MetricsRegistry) -> list[dict]:
                  "rebuilt chunks failing the end-to-end hash gate")):
             fams.append(_fam(f"cb_repair_{key}_total", COUNTER,
                              f"repair planner: {help_}",
-                             [_scalar(s[key])]))
+                             [_scalar(by_code[c].get(key, 0), code=c)
+                              for c in codes]))
 
     scrubs = [s.stats().to_obj() for s in reg._live_sources("scrub")]
     if scrubs:
@@ -552,6 +558,18 @@ def _source_families(reg: MetricsRegistry) -> list[dict]:
                          "scrub byte-rate bound", [_scalar(
                              sum(x["rate_bytes_per_sec"]
                                  for x in scrubs))]))
+
+    scheds = [s.info() for s in reg._live_sources("xor_schedule")]
+    if scheds:
+        s = _sum_rows(scheds, ("hits", "misses", "evictions", "size"))
+        for key in ("hits", "misses", "evictions"):
+            fams.append(_fam(f"cb_xor_schedule_{key}_total", COUNTER,
+                             f"scheduled-XOR program cache {key} "
+                             "(ops/xor_schedule.py LRU)",
+                             [_scalar(s[key])]))
+        fams.append(_fam("cb_xor_schedule_entries", GAUGE,
+                         "scheduled-XOR program cache entries",
+                         [_scalar(s["size"])]))
 
     return fams
 

@@ -238,8 +238,16 @@ def row_hasher() -> Callable[..., None]:
     return _ROW_HASHER
 
 
-_CODER_CACHE: dict[tuple[int, int, str], "ErasureCoder"] = {}
+_CODER_CACHE: dict[tuple[int, int, str, str], "ErasureCoder"] = {}
 _CODER_LOCK = threading.Lock()
+
+#: the closed set of erasure codes a part may declare (file/chunk.py
+#: ``code:`` field): classic Reed-Solomon and the product-matrix MSR
+#: regenerating code (ops/pm_msr.py).  Anything else is a
+#: newer/foreign writer — readers degrade to a clean error, never a
+#: guess (a non-member code could be non-systematic, so even a
+#: fully-healthy read must refuse rather than concatenate data chunks)
+KNOWN_CODES = ("rs", "pm-msr")
 
 
 class ErasureCoder:
@@ -249,6 +257,14 @@ class ErasureCoder:
     Batched variants take uint8 arrays shaped [B, shards, S]; the scalar
     variants mirror the crate's per-part API and are thin wrappers.
     """
+
+    #: wire-format code name (file/chunk.py ``code:`` field); the
+    #: product-matrix MSR subclass (ops/pm_msr.py) overrides
+    code = "rs"
+    #: whether the host pipeline's chunk-granular fused native ingest
+    #: (parity_rows applied to [B, d, S] + per-stripe SHA in one pass)
+    #: is valid for this code; sub-symbol codes take the decomposed path
+    supports_fused_ingest = True
 
     def __init__(self, data: int, parity: int,
                  backend: Optional[ErasureBackend] = None) -> None:
@@ -261,6 +277,13 @@ class ErasureCoder:
         self.backend = backend or get_backend()
         self.encode_matrix = matrix.build_encode_matrix(data, parity)
         self.parity_rows = self.encode_matrix[data:]
+
+    def shard_len(self, length: int) -> int:
+        """Bytes per shard for a part holding ``length`` meaningful
+        bytes — the reference's round-up split
+        (src/file/file_part.rs:150-158).  Sub-symbol codes round up
+        further so every chunk divides into equal stripes."""
+        return (length + self.data - 1) // self.data if length > 0 else 0
 
     # ---- batched API (the TPU-friendly surface) ----
 
@@ -292,7 +315,8 @@ class ErasureCoder:
             raise ErasureError(
                 f"expected data shaped [B, {self.data}, S], got {data.shape}"
             )
-        fused = getattr(self.backend, "encode_and_hash", None)
+        fused = (getattr(self.backend, "encode_and_hash", None)
+                 if self.supports_fused_ingest else None)
         if fused is not None:
             return fused(self.parity_rows, np.ascontiguousarray(data))
         data = np.ascontiguousarray(data)
@@ -412,13 +436,28 @@ class ErasureCoder:
 
 
 def get_coder(data: int, parity: int,
-              backend: Optional[str] = None) -> ErasureCoder:
-    """Cached coder lookup; matrices are rebuilt once per (d, p, backend)."""
+              backend: Optional[str] = None,
+              code: str = "rs") -> ErasureCoder:
+    """Cached coder lookup; matrices are rebuilt once per
+    (d, p, backend, code).  ``code`` is the per-part wire-format value
+    ("rs" — the default and the only value old references carry — or
+    "pm-msr", the product-matrix MSR regenerating code); an unknown
+    value raises ErasureError so callers degrade to a clean read error
+    instead of guessing at a foreign writer's math."""
+    if code not in KNOWN_CODES:
+        raise ErasureError(
+            f"unknown erasure code {code!r} (this reader knows "
+            f"{', '.join(KNOWN_CODES)})")
     be = get_backend(backend)
-    key = (data, parity, be.name)
+    key = (data, parity, be.name, code)
     with _CODER_LOCK:
         coder = _CODER_CACHE.get(key)
         if coder is None:
-            coder = ErasureCoder(data, parity, be)
+            if code == "pm-msr":
+                from chunky_bits_tpu.ops.pm_msr import PMMSRCoder
+
+                coder = PMMSRCoder(data, parity, be)
+            else:
+                coder = ErasureCoder(data, parity, be)
             _CODER_CACHE[key] = coder
         return coder

@@ -145,11 +145,13 @@ class ReconstructBatcher(_CoalescingBatcher):
 
     async def reconstruct(
         self, d: int, p: int, arrays: Sequence[Optional[np.ndarray]],
-        data_only: bool = False,
+        data_only: bool = False, code: str = "rs",
     ) -> list[Optional[np.ndarray]]:
         """Async equivalent of ``ErasureCoder.reconstruct`` /
         ``reconstruct_data`` (crate call sites file_part.rs:128,302-305):
         fill the ``None`` rows of ``arrays`` (all d+p slots, data first).
+        ``code`` is the part's wire-format erasure code — requests only
+        coalesce within a code (the decode matrices differ).
         """
         total = d + p
         if len(arrays) != total:
@@ -172,13 +174,13 @@ class ReconstructBatcher(_CoalescingBatcher):
         for i in present[1:]:
             if len(arrays[i]) != size:
                 raise ErasureError("shards must be of equal length")
-        key = (d, p, present, wanted, size)
+        key = (d, p, present, wanted, size, code)
         return await self._submit(key, arrays)
 
     def _run_group(self, key: tuple, requests: list[list]) -> list[list]:
-        d, p, present, wanted, size = key
+        d, p, present, wanted, size, code = key
         self.dispatches += 1
-        coder = get_coder(d, p, self.backend)
+        coder = get_coder(d, p, self.backend, code)
         # stack straight into decode layout (the first d present rows,
         # ascending) — one gather pass instead of a full [B, d+p, S]
         # scatter followed by reconstruct_batch's row-pick copy
@@ -230,11 +232,12 @@ class EncodeHashBatcher(_CoalescingBatcher):
         self.host_pipeline = host_pipeline
 
     async def encode_hash(
-        self, d: int, p: int, stacked: np.ndarray
+        self, d: int, p: int, stacked: np.ndarray, code: str = "rs",
     ) -> tuple[np.ndarray, np.ndarray]:
         """Async equivalent of ``ErasureCoder.encode_hash_batch`` for one
         staged part batch ``stacked[B, d, S]``: returns
-        ``(parity[B, p, S], digests[B, d+p, 32])``."""
+        ``(parity[B, p, S], digests[B, d+p, 32])``.  ``code`` selects
+        the erasure code; batches only merge within a code."""
         if stacked.ndim != 3 or stacked.shape[1] != d:
             raise ErasureError(
                 f"expected stacked [B, {d}, S], got {stacked.shape}")
@@ -242,7 +245,7 @@ class EncodeHashBatcher(_CoalescingBatcher):
         if b == 0:
             return (np.zeros((0, p, size), dtype=np.uint8),
                     np.zeros((0, d + p, 32), dtype=np.uint8))
-        key = (d, p, size)
+        key = (d, p, size, code)
         return await self._submit(key, stacked)
 
     def _encode(self, coder, stacked: np.ndarray):
@@ -255,8 +258,8 @@ class EncodeHashBatcher(_CoalescingBatcher):
         return coder.encode_hash_batch(stacked)
 
     def _run_group(self, key: tuple, batches: list[np.ndarray]) -> list:
-        d, p, _size = key
-        coder = get_coder(d, p, self.backend)
+        d, p, _size, code = key
+        coder = get_coder(d, p, self.backend, code)
         # Merging pending batches into one [ΣB, d, S] dispatch costs a
         # full extra memcpy (the concatenate).  Device backends earn it
         # back many times over in saved per-dispatch RPC; the CPU

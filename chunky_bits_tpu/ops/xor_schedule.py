@@ -272,6 +272,14 @@ class ScheduleCache:
         self.evictions = 0
         self._lock = threading.Lock()
         self._entries: "OrderedDict[bytes, XorSchedule]" = OrderedDict()
+        # weakly self-register so /metrics and /stats surface the LRU's
+        # hit/miss/eviction counters (cb_xor_schedule_*) — the same
+        # polled-source pattern as the chunk cache; the process-shared
+        # _CACHE below lives for the process, per-test instances drop
+        # out with their owners (the registry holds only a weakref)
+        from chunky_bits_tpu.obs.metrics import get_registry
+
+        get_registry().register_source("xor_schedule", self)
 
     def get(self, mat: np.ndarray) -> XorSchedule:
         key = matrix_digest(mat)

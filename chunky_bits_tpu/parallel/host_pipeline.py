@@ -485,9 +485,18 @@ class HostPipeline:
         cap = getattr(coder.backend, "nthreads", 0) or 0
         k = self.threads if cap <= 0 else min(self.threads, cap)
         fused_into = getattr(coder.backend, "encode_and_hash_into", None)
+        fused_whole = getattr(coder.backend, "encode_and_hash", None)
+        if not getattr(coder, "supports_fused_ingest", True):
+            # sub-symbol codes (pm-msr): the backend's fused passes
+            # apply parity_rows at chunk granularity — wrong matrix
+            # shape for a stripe-structured code.  Null BOTH so such
+            # coders take the decomposed path below: it calls the
+            # coder's own encode_batch (exact) with per-shard hashing
+            # sliced across the workers, overlapping device dispatch
+            # the same way — never a single-threaded whole-batch job
+            fused_into = fused_whole = None
 
-        if fused_into is None and getattr(coder.backend, "encode_and_hash",
-                                          None) is not None:
+        if fused_into is None and fused_whole is not None:
             # a device backend with its own fused/overlapped ingest path
             # (jax: device parity + per-block host hashing — which
             # already rides this pipeline's workers internally): the

@@ -46,8 +46,11 @@ def cluster_spec(meta_path: str) -> dict:
             {"location": "d4", "repeat": 1},
         ],
         "metadata": {"type": "path", "format": "yaml", "path": meta_path},
+        # code pinned to "rs" in YAML (which wins over any inherited
+        # $CHUNKY_BITS_TPU_CODE — the CI pm-msr matrix leg): fixtures
+        # 3/4 freeze the CLASSIC wire format; fixture 6 freezes pm-msr
         "profiles": {"default": {"data": 3, "parity": 2,
-                                 "chunk_size": 12}},
+                                 "chunk_size": 12, "code": "rs"}},
         # pinned OFF in YAML (which wins over any inherited
         # $CHUNKY_BITS_TPU_REPAIR_BLOCK_BYTES): these fixtures freeze
         # the CLASSIC wire format; fixture 5 freezes the tree format
@@ -124,6 +127,21 @@ async def build_refs() -> dict[str, dict]:
                  .with_repair_block_bytes(4096)
                  .write(aio.BytesReader(payload(100_000, 1))))
     refs["block_digests"] = ref.to_obj()
+
+    # 6. fixture 1's exact payload under the product-matrix MSR code
+    # (ops/pm_msr.py): pins the `code: pm-msr` wire format BOTH ways —
+    # data chunks stay byte-identical to fixture 1 (the code is
+    # systematic and the shard split is unchanged at this geometry,
+    # alpha=2 | every shard length), parity chunks pin the pm-msr
+    # GF(2^8) generator matrix through their content addresses, and
+    # the `code` key is the ONLY structural delta (tests assert
+    # stripping it reproduces a classic-parseable ref)
+    ref = await (FileWriteBuilder()
+                 .with_chunk_size(1 << 14)
+                 .with_data_chunks(3).with_parity_chunks(2)
+                 .with_code("pm-msr")
+                 .write(aio.BytesReader(payload(100_000, 1))))
+    refs["pm_msr_placement"] = ref.to_obj()
     return refs
 
 

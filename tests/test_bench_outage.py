@@ -241,6 +241,53 @@ def test_config12_failure_emits_one_json_line():
     assert "error" in rec
 
 
+def test_config13_smoke_emits_one_json_line():
+    """--config 13 --smoke (pm-msr vs rs repair-bandwidth A/B at CI
+    scale) honors the driver contract: exactly one parseable JSON line
+    on stdout with the required keys plus the per-leg fields, exit 0 —
+    and the run itself asserts repaired objects byte-identical to
+    their payloads on both legs and pm-msr encode/repair byte-identical
+    across backends."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "13", "--smoke"],
+        cwd=REPO, env=env, capture_output=True, timeout=300)
+    assert r.returncode == 0, r.stderr.decode()[-800:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline",
+                "bytes_per_rebuilt_rs", "bytes_per_rebuilt_pm",
+                "helper_b_rs", "helper_b_pm", "alpha", "helpers",
+                "disk_read_rs_b", "disk_read_pm_b", "plans_msr",
+                "plans_decode_rs", "wall_rs_s", "wall_pm_s"):
+        assert key in rec
+    assert rec["value"] > 0
+    assert rec["unit"] == "x"
+    # the regenerating code's structural win: strictly fewer helper
+    # bytes per rebuilt byte than the rs leg's d x damage floor
+    assert rec["bytes_per_rebuilt_pm"] < rec["bytes_per_rebuilt_rs"]
+
+
+def test_config13_failure_emits_one_json_line():
+    """ANY --config 13 failure (here: invalid parameters) still
+    produces exactly one parseable JSON line and exit 3 — the same
+    contract as configs 8-12 and the device runs."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "13",
+         "--corrupt", "0"],
+        cwd=REPO, env=env, capture_output=True, timeout=120)
+    assert r.returncode == 3, r.stderr.decode()[-500:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec
+    assert rec["value"] == 0.0
+    assert "error" in rec
+
+
 def test_seams_only_shrink_and_tolerate_garbage():
     """Inherited env values must not break the contract: malformed or
     larger-than-default values fall back to the real budget."""

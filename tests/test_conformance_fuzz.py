@@ -104,6 +104,178 @@ def test_xor_schedule_conformance(seed):
             assert np.array_equal(out[i], full[0, i]), (d, p, erased, i)
 
 
+def _pm_geometry(rng):
+    """A random geometry pm-msr supports: k >= 2, p >= k-1."""
+    k = int(rng.integers(2, 7))
+    p = int(rng.integers(k - 1, k + 3))
+    return k, p
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_pm_msr_conformance(seed):
+    """Product-matrix MSR leg of the sweep (ops/pm_msr.py): random
+    supported geometry / alpha-divisible stripe lengths / random
+    erasure patterns AND random single-chunk regenerations, asserting
+    the numpy-backend coder (the oracle) and the native coder emit
+    byte-identical parity, reconstructions, helper projections and
+    regenerated chunks — plus round-trip against the original data."""
+    from chunky_bits_tpu.ops.pm_msr import PMMSRCoder
+
+    rng = np.random.default_rng(900 + seed)
+    k, p = _pm_geometry(rng)
+    alpha, dh = k - 1, 2 * (k - 1)
+    size = int(rng.integers(1, 400)) * alpha
+    batch = int(rng.integers(1, 4))
+
+    data = rng.integers(0, 256, (batch, k, size), dtype=np.uint8)
+    oracle = PMMSRCoder(k, p, NumpyBackend())
+    native = PMMSRCoder(k, p, _native_or_skip())
+
+    parity = oracle.encode_batch(data)
+    assert np.array_equal(parity, native.encode_batch(data))
+    full = np.concatenate([data, parity], axis=1)
+
+    for _ in range(4):
+        n_erase = int(rng.integers(1, p + 1))
+        erased = rng.choice(k + p, size=n_erase, replace=False)
+        shards = [None if i in erased else full[0, i]
+                  for i in range(k + p)]
+        for coder in (oracle, native):
+            out = coder.reconstruct(list(shards))
+            for i in range(k + p):
+                assert np.array_equal(out[i], full[0, i]), \
+                    (k, p, erased, i, coder.backend.name)
+
+    for _ in range(3):
+        failed = int(rng.integers(0, k + p))
+        others = [i for i in range(k + p) if i != failed]
+        helpers = sorted(rng.permutation(others)[:dh].tolist())
+        projs = np.stack([oracle.project_batch(failed, full[:, h, :])
+                          for h in helpers], axis=1)
+        projs_nat = np.stack([native.project_batch(failed, full[:, h, :])
+                              for h in helpers], axis=1)
+        assert np.array_equal(projs, projs_nat)
+        # each helper ships beta = size/alpha bytes: dh*beta = 2*size
+        assert projs.shape == (batch, dh, size // alpha)
+        regen = oracle.repair_batch(failed, helpers, projs)
+        assert np.array_equal(regen, full[:, failed, :]), (k, p, failed)
+        assert np.array_equal(
+            native.repair_batch(failed, helpers, projs), regen)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_pm_msr_xor_schedule_conformance(seed):
+    """The engine-on leg: every pm-msr matrix apply (encode, decode,
+    projection, repair combine) lowered through the scheduled-XOR
+    engine must stay byte-identical to the numpy oracle — the same
+    route a CHUNKY_BITS_TPU_XOR_SCHEDULE=1 host runs repair on."""
+    from chunky_bits_tpu.ops.pm_msr import PMMSRCoder
+
+    rng = np.random.default_rng(950 + seed)
+    k, p = _pm_geometry(rng)
+    alpha, dh = k - 1, 2 * (k - 1)
+    # plane-eligible sub-stripe lengths (S/alpha % 8 == 0) so the
+    # engine runs rather than falling back to the table path
+    size = int(rng.integers(1, 60)) * 8 * alpha
+    data = rng.integers(0, 256, (2, k, size), dtype=np.uint8)
+    oracle = PMMSRCoder(k, p, NumpyBackend())
+    try:
+        from chunky_bits_tpu.ops.cpu_backend import NativeBackend
+
+        xor = PMMSRCoder(k, p, NativeBackend(xor_schedule=True))
+    except Exception as err:  # pragma: no cover - no compiler in env
+        pytest.skip(f"native backend unavailable: {err}")
+
+    parity = oracle.encode_batch(data)
+    assert np.array_equal(parity, xor.encode_batch(data))
+    full = np.concatenate([data, parity], axis=1)
+    erased = rng.choice(k + p, size=p, replace=False)
+    shards = [None if i in erased else full[0, i] for i in range(k + p)]
+    out = xor.reconstruct(list(shards))
+    for i in range(k + p):
+        assert np.array_equal(out[i], full[0, i]), (k, p, erased, i)
+    failed = int(rng.integers(0, k + p))
+    helpers = [i for i in range(k + p) if i != failed][:dh]
+    projs = np.stack([xor.project_batch(failed, full[:, h, :])
+                      for h in helpers], axis=1)
+    assert np.array_equal(
+        projs, np.stack([oracle.project_batch(failed, full[:, h, :])
+                         for h in helpers], axis=1))
+    assert np.array_equal(xor.repair_batch(failed, helpers, projs),
+                          full[:, failed, :])
+
+
+def test_pm_msr_jax_conformance():
+    """The device-backend leg (virtual CPU mesh in CI): pm-msr parity,
+    reconstruction and regeneration through the jax bit-plane backend
+    must match the numpy oracle byte-for-byte — the code rides the
+    same apply_matrix primitive, so this pins the whole dispatch
+    path, not new kernels."""
+    from chunky_bits_tpu.ops.backend import get_backend
+    from chunky_bits_tpu.ops.pm_msr import PMMSRCoder
+
+    k, p = 3, 2
+    alpha, dh = k - 1, 2 * (k - 1)
+    rng = np.random.default_rng(1000)
+    size = 64 * alpha
+    data = rng.integers(0, 256, (2, k, size), dtype=np.uint8)
+    oracle = PMMSRCoder(k, p, NumpyBackend())
+    jax_coder = PMMSRCoder(k, p, get_backend("jax"))
+    parity = oracle.encode_batch(data)
+    assert np.array_equal(parity, jax_coder.encode_batch(data))
+    full = np.concatenate([data, parity], axis=1)
+    shards = [None if i in (0, 4) else full[0, i] for i in range(k + p)]
+    out = jax_coder.reconstruct(list(shards))
+    for i in range(k + p):
+        assert np.array_equal(out[i], full[0, i]), i
+    helpers = [1, 2, 3, 4]
+    projs = np.stack([jax_coder.project_batch(0, full[:, h, :])
+                      for h in helpers], axis=1)
+    assert np.array_equal(jax_coder.repair_batch(0, helpers, projs),
+                          full[:, 0, :])
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_pm_msr_rejections(seed):
+    """The failure surface: unsupported geometry, unknown code names,
+    too-few helpers, and non-alpha-divisible stripe lengths all raise
+    ErasureError — never wrong bytes."""
+    from chunky_bits_tpu.ops.backend import get_coder
+    from chunky_bits_tpu.ops.pm_msr import PMMSRCoder, geometry_error
+
+    # parity below the helper budget
+    assert geometry_error(5, 3) is not None
+    with pytest.raises(ErasureError):
+        PMMSRCoder(5, 3, NumpyBackend())
+    # k=1 has no sub-symbol structure
+    with pytest.raises(ErasureError):
+        PMMSRCoder(1, 2, NumpyBackend())
+    with pytest.raises(ErasureError):
+        get_coder(3, 2, "numpy", code="no-such-code")
+
+    rng = np.random.default_rng(1100 + seed)
+    k, p = _pm_geometry(rng)
+    if k < 3:
+        k, p = 3, 2  # alpha >= 2 so indivisible lengths exist
+    coder = PMMSRCoder(k, p, NumpyBackend())
+    bad = rng.integers(0, 256, (1, k, (k - 1) * 8 + 1), dtype=np.uint8)
+    with pytest.raises(ErasureError):
+        coder.encode_batch(bad)
+    good = rng.integers(0, 256, (1, k, (k - 1) * 8), dtype=np.uint8)
+    parity = coder.encode_batch(good)
+    full = np.concatenate([good, parity], axis=1)
+    with pytest.raises(ErasureError):
+        coder.repair_matrix(0, list(range(1, 2 * (k - 1))))  # short
+    with pytest.raises(ErasureError):
+        coder.repair_matrix(0, [0] + list(range(2, 2 * (k - 1) + 1)))
+    # projections stacked for the wrong helper count are refused too
+    helpers = list(range(1, 2 * (k - 1) + 1))
+    projs = np.stack([coder.project_batch(0, full[:, h, :])
+                      for h in helpers], axis=1)
+    with pytest.raises(ErasureError):
+        coder.repair_batch(0, helpers, projs[:, :-1, :])
+
+
 @pytest.mark.parametrize("seed", range(4))
 def test_too_many_erasures_raise(seed):
     rng = np.random.default_rng(100 + seed)

@@ -25,7 +25,8 @@ def golden_text(name: str) -> str:
 def test_fixtures_match_current_behavior():
     refs = asyncio.run(gen.build_refs())
     assert set(refs) == {"void_small", "void_wide", "cluster_placement",
-                         "slab_placement", "block_digests"}
+                         "slab_placement", "block_digests",
+                         "pm_msr_placement"}
     for name, obj in refs.items():
         assert gen.dump(obj) == golden_text(name), (
             f"golden fixture {name} drifted — wire compatibility broken "
@@ -76,6 +77,169 @@ def test_block_digest_fixture_is_strictly_additive():
             assert chunk.blocks is not None
             assert chunk.blocks.size == 4096
             assert chunk.blocks.covers(part.chunksize)
+
+
+def test_pm_msr_fixture_is_strictly_additive():
+    """Fixture 6 differs from fixture 1 ONLY by the per-part ``code``
+    key and the parity content addresses: the code is systematic, so
+    the data chunks (and the structure around them) stay byte-identical
+    — the regenerating code is a parity-math change on the same wire
+    format, never a format fork."""
+    import yaml
+
+    plain = yaml.safe_load(golden_text("void_small"))
+    msr = yaml.safe_load(golden_text("pm_msr_placement"))
+    stripped = yaml.safe_load(golden_text("pm_msr_placement"))
+    for part in stripped["parts"]:
+        assert part.pop("code") == "pm-msr"
+        part.pop("parity", None)
+    rs_no_parity = yaml.safe_load(golden_text("void_small"))
+    for part in rs_no_parity["parts"]:
+        part.pop("parity", None)
+    assert stripped == rs_no_parity, (
+        "pm_msr_placement minus code+parity must BE void_small's data")
+    # parity DOES differ — same geometry, different generator matrix;
+    # identical parity would mean the pm-msr matrices silently
+    # degenerated to Reed-Solomon
+    for p_part, m_part in zip(plain["parts"], msr["parts"]):
+        assert [c["sha256"] for c in p_part["parity"]] != \
+            [c["sha256"] for c in m_part["parity"]]
+
+
+def test_pm_msr_fixture_roundtrips_with_code():
+    """Parse -> serialize preserves the ``code`` key byte-for-byte, and
+    a ``code``-stripped document parses as a CLASSIC rs ref whose
+    re-serialization is byte-identical to the stripped document (the
+    key is the only delta an old writer would not produce)."""
+    import yaml
+
+    from chunky_bits_tpu.file.file_reference import FileReference
+
+    obj = yaml.safe_load(golden_text("pm_msr_placement"))
+    ref = FileReference.from_obj(obj)
+    assert all(part.code == "pm-msr" for part in ref.parts)
+    assert gen.dump(ref.to_obj()) == golden_text("pm_msr_placement")
+
+    stripped = yaml.safe_load(golden_text("pm_msr_placement"))
+    for part in stripped["parts"]:
+        del part["code"]
+    as_classic = FileReference.from_obj(stripped)
+    assert all(part.code == "rs" for part in as_classic.parts)
+    assert as_classic.to_obj() == stripped
+
+
+def test_foreign_code_degrades_to_clean_read_error():
+    """A reference declaring a code this build does not ship reads as
+    a clean FileReadError (a ChunkyBitsError the CLI reports per
+    file), never a crash — and resilver refuses identically."""
+    import yaml
+
+    from chunky_bits_tpu.errors import ChunkyBitsError, FileReadError
+    from chunky_bits_tpu.file.file_reference import FileReference
+
+    obj = yaml.safe_load(golden_text("pm_msr_placement"))
+    for part in obj["parts"]:
+        part["code"] = "lrc-12"  # a plausible FUTURE code name
+    ref = FileReference.from_obj(obj)  # parsing itself must succeed
+    assert all(part.code == "lrc-12" for part in ref.parts)
+
+    async def read():
+        return await ref.parts[0].read()
+
+    with pytest.raises(FileReadError) as err:
+        asyncio.run(read())
+    assert "lrc-12" in str(err.value)
+    assert isinstance(err.value, ChunkyBitsError)
+
+
+def test_null_code_parses_as_rs():
+    """An explicit ``code: null`` in a hand-edited/tool-round-tripped
+    ref means unset, exactly like an absent key — it must parse as rs
+    (and re-serialize without the key), never as the unreadable
+    foreign code "None"."""
+    import yaml
+
+    from chunky_bits_tpu.file.file_reference import FileReference
+
+    obj = yaml.safe_load(golden_text("pm_msr_placement"))
+    for part in obj["parts"]:
+        part["code"] = None
+    ref = FileReference.from_obj(obj)
+    assert all(part.code == "rs" for part in ref.parts)
+    assert all("code" not in part for part in ref.to_obj()["parts"])
+
+
+def test_foreign_code_disqualifies_sendfile_fast_path():
+    """The gateway's ranged-GET zero-copy path serves raw chunk bytes,
+    which is only sound for systematic shipped codes — a part carrying
+    a foreign ``code:`` must fall through to the generic read (and its
+    clean per-part error), never sendfile a guess."""
+    import yaml
+
+    from chunky_bits_tpu.file.file_reference import FileReference
+    from chunky_bits_tpu.gateway.http import _covering_chunk
+
+    obj = yaml.safe_load(golden_text("pm_msr_placement"))
+    ref = FileReference.from_obj(obj)
+    covered = _covering_chunk(ref, 0, 16)
+    assert covered is not None  # pm-msr is systematic: qualifies
+    assert covered[0] is ref.parts[0].data[0]
+
+    for part in obj["parts"]:
+        part["code"] = "lrc-12"
+    foreign = FileReference.from_obj(obj)
+    assert _covering_chunk(foreign, 0, 16) is None
+
+
+def test_interop_decoder_ignores_code_key_on_rs_refs(tmp_path):
+    """python/chunky-bits.py-style readers (concatenate data chunks,
+    check sha256, truncate to length) must keep working on an rs ref
+    even when a ``code: rs`` key is present — and, because pm-msr is
+    systematic, on a pm-msr ref too."""
+    import importlib.util
+    import io
+
+    import yaml
+
+    from chunky_bits_tpu.file import FileWriteBuilder
+    from chunky_bits_tpu.utils import aio
+
+    spec = importlib.util.spec_from_file_location(
+        "cb_interop", os.path.join(os.path.dirname(gen.GOLDEN_DIR),
+                                   "..", "python", "chunky-bits.py"))
+    interop = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(interop)
+
+    payload = gen.payload(50_000, 9)
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        dirs = [f"d{i}" for i in range(5)]
+        for name in dirs:
+            os.mkdir(name)
+
+        async def build(code):
+            return await (FileWriteBuilder()
+                          .with_chunk_size(1 << 12)
+                          .with_data_chunks(3).with_parity_chunks(2)
+                          .with_destination(list(dirs))
+                          .with_code(code)
+                          .write(aio.BytesReader(payload)))
+
+        for code in ("rs", "pm-msr"):
+            obj = asyncio.run(build(code)).to_obj()
+            for part in obj["parts"]:
+                # the rs ref never emits the key; inject it explicitly
+                # to prove foreign readers skip unknown keys
+                part["code"] = code
+            ref_path = f"ref-{code}.yaml"
+            with open(ref_path, "w") as f:
+                yaml.safe_dump(obj, f, sort_keys=False)
+            out = io.BytesIO()
+            assert interop.decode(ref_path, out) == 0
+            assert out.getvalue() == payload, code
+    finally:
+        os.chdir(cwd)
 
 
 def test_old_reference_without_blocks_parses_and_roundtrips():

@@ -185,6 +185,29 @@ def test_encode_hash_identity_fuzz(backend):
                     (backend, pipe.threads, b, d, p, s)
 
 
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_encode_hash_identity_pm_msr(backend):
+    """pm-msr (supports_fused_ingest=False) must skip BOTH backend
+    fused passes and take the decomposed path — per-shard hashing
+    sliced across the workers, the coder's own stripe encode — never a
+    single-threaded whole-batch delegation; bytes identical to the
+    unsliced coder at every worker count."""
+    rng = np.random.default_rng(4321)
+    coder = get_coder(5, 4, backend, "pm-msr")
+    with pipeline(1) as p1, pipeline(4) as p4:
+        for b, s in [(1, 4096), (4, 8192), (3, 64)]:
+            data = rng.integers(0, 256, (b, 5, s), dtype=np.uint8)
+            want = coder.encode_hash_batch(data)
+            for pipe in (p1, p4):
+                got = pipe.encode_hash_sync(coder, data)
+                assert np.array_equal(got[0], want[0]), (backend, b, s)
+                assert np.array_equal(got[1], want[1]), (backend, b, s)
+        stages = {st.stage: st for st in p4.stats().stages}
+        # the decomposed path queues sliced "hash" jobs; the
+        # delegation branch would run ONE opaque "encode" job only
+        assert "hash" in stages and stages["hash"].jobs > 1
+
+
 def test_encode_hash_identity_jax_backend():
     """The jax backend delegates to its own fused/overlapped path (which
     hashes on the shared pipeline internally) — output must still match

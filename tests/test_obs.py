@@ -267,6 +267,67 @@ def test_health_source_values_are_exact(tmp_path):
     assert fams["cb_node_errors_total"]["samples"][0]["value"] == 2
 
 
+def test_repair_source_families_carry_the_code_label():
+    """Every cb_repair_* family splits by the CLOSED erasure-code set
+    (cluster.repair.CODES): per-code counters appear verbatim under
+    their code= label, cross-code samples coexist in one family, and
+    the exposition stays grammar-clean."""
+    from chunky_bits_tpu.cluster.repair import RepairPlanner
+
+    planner = RepairPlanner()
+    planner._bump("rs", plans_decode=2, helper_bytes_decode=4096,
+                  bytes_rebuilt=1024)
+    planner._bump("pm-msr", plans_msr=3, helper_bytes_msr=8192,
+                  bytes_rebuilt=4096)
+    reg = MetricsRegistry()
+    reg.register_source("repair", planner)
+    fams = {f["name"]: f for f in reg.snapshot()["families"]}
+
+    def val(fam, **labels):
+        for s in fams[fam]["samples"]:
+            if all(s["labels"].get(k) == v for k, v in labels.items()):
+                return s["value"]
+        raise AssertionError((fam, labels, fams[fam]["samples"]))
+
+    assert val("cb_repair_plans_total", kind="decode", code="rs") == 2
+    assert val("cb_repair_plans_total", kind="msr", code="pm-msr") == 3
+    assert val("cb_repair_plans_total", kind="msr", code="rs") == 0
+    assert val("cb_repair_helper_bytes_total", source="decode",
+               code="rs") == 4096
+    assert val("cb_repair_helper_bytes_total", source="msr",
+               code="pm-msr") == 8192
+    assert val("cb_repair_bytes_rebuilt_total", code="rs") == 1024
+    assert val("cb_repair_bytes_rebuilt_total", code="pm-msr") == 4096
+    obs_metrics.parse_exposition(reg.render())
+
+
+def test_xor_schedule_cache_is_a_metrics_source():
+    """The scheduled-XOR program LRU surfaces its hit/miss/eviction
+    counters through the registry (the PR-10 cache was observable only
+    in-process): a real cache's traffic lands in cb_xor_schedule_* and
+    two caches sum, per the polled-source contract."""
+    from chunky_bits_tpu.ops import matrix as gf_matrix
+    from chunky_bits_tpu.ops.xor_schedule import ScheduleCache
+
+    reg = MetricsRegistry()
+    cache = ScheduleCache(maxsize=1)
+    # ScheduleCache self-registers with the PROCESS registry; the test
+    # registry observes the same object explicitly
+    reg.register_source("xor_schedule", cache)
+    enc = gf_matrix.build_encode_matrix(3, 2)
+    cache.get(enc[3:])           # miss
+    cache.get(enc[3:])           # hit
+    cache.get(enc[3:, ::-1])     # miss + eviction (maxsize=1)
+    fams = {f["name"]: f for f in reg.snapshot()["families"]}
+    assert fams["cb_xor_schedule_hits_total"]["samples"][0]["value"] == 1
+    assert (fams["cb_xor_schedule_misses_total"]["samples"][0]["value"]
+            == 2)
+    assert (fams["cb_xor_schedule_evictions_total"]["samples"][0]
+            ["value"] == 1)
+    assert fams["cb_xor_schedule_entries"]["samples"][0]["value"] == 1
+    obs_metrics.parse_exposition(reg.render())
+
+
 # ---- event-loop lag ----
 
 def test_loop_lag_monitor_observes_a_blocked_loop():

@@ -26,7 +26,12 @@ from chunky_bits_tpu.utils import aio
 
 
 def make_cluster_obj(root, packed=True, d=3, p=2, chunk_log2=12,
-                     n_nodes=5, tunables=None):
+                     n_nodes=5, tunables=None, code=None):
+    """``code`` pins the profile's erasure code in YAML (winning over
+    the $CHUNKY_BITS_TPU_CODE env default the CI pm-msr matrix leg
+    sets); None leaves the profile env-driven — tests that assert
+    rs-specific byte accounting pass code="rs", generic behavioral
+    tests stay unpinned so both codes exercise them."""
     dirs = []
     for i in range(n_nodes):
         path = os.path.join(str(root), f"disk{i}")
@@ -34,11 +39,13 @@ def make_cluster_obj(root, packed=True, d=3, p=2, chunk_log2=12,
         dirs.append(f"slab:{path}" if packed else path)
     meta = os.path.join(str(root), "meta")
     os.makedirs(meta, exist_ok=True)
+    profile = {"data": d, "parity": p, "chunk_size": chunk_log2}
+    if code is not None:
+        profile["code"] = code
     obj = {
         "destinations": [{"location": x} for x in dirs],
         "metadata": {"type": "path", "format": "yaml", "path": meta},
-        "profiles": {"default": {"data": d, "parity": p,
-                                 "chunk_size": chunk_log2}},
+        "profiles": {"default": profile},
     }
     if tunables:
         obj["tunables"] = tunables
