@@ -462,11 +462,120 @@ class MetricLabelCardinalityRule(Rule):
                            "<reason>`")
 
 
+class ClockSeamRule(Rule):
+    """CB108 — the clock seam cannot silently rot.
+
+    Every time-sensitive policy in the cluster/file planes (EWMA decay,
+    breaker cooldowns, token buckets, hedge delays, retry backoff, I/O
+    latency samples) resolves time through ``cluster/clock.py`` (the
+    seam ``chunky_bits_tpu/utils/clock.py`` implements), so the
+    deterministic cluster simulator (``chunky_bits_tpu/sim``) can swap
+    in a virtual clock and compress hours of scenario into seconds.  A
+    direct ``time.monotonic()`` / ``time.time()`` / ``loop.time()``
+    read in ``cluster/``, ``file/`` or ``ops/batching.py`` would tick
+    in REAL time inside a virtual-time run — every duration touching
+    it silently corrupts.  Justified wall-clock sites (human-facing
+    timestamps like slab publish stamps) carry
+    ``# lint: clock-ok <reason>``; the seam module itself is the one
+    sanctioned home for direct reads.
+    """
+
+    id = "CB108"
+    slug = "clock"
+    description = ("cluster/file-plane time reads go through the "
+                   "cluster/clock.py seam")
+    paths = ("cluster/", "file/", "ops/batching.py")
+
+    #: the clock-read function names (incl. the nanosecond spellings —
+    #: a ns read mixes timebases just as silently); alias-import
+    #: tracking follows the CB102 convention: `from time import
+    #: monotonic` and `import time as t` must not slip past the lint
+    DIRECT_NAMES = ("monotonic", "time", "perf_counter",
+                    "monotonic_ns", "time_ns", "perf_counter_ns")
+
+    #: direct stdlib reads that bypass the seam outright
+    DIRECT = tuple(f"time.{name}" for name in DIRECT_NAMES)
+
+    def applies(self, rel: str) -> bool:
+        return rel != "cluster/clock.py" and super().applies(rel)
+
+    @staticmethod
+    def _is_loop_call(value: ast.AST) -> bool:
+        """True when ``value`` is a call that yields an event loop
+        (``asyncio.get_running_loop()`` / ``get_event_loop()`` /
+        ``new_event_loop()``) — the call-result spelling of
+        ``loop.time()``."""
+        if not isinstance(value, ast.Call):
+            return False
+        callee = _attr_chain(value.func)
+        return callee.rsplit(".", 1)[-1] in (
+            "get_running_loop", "get_event_loop", "new_event_loop")
+
+    def check(self, sf) -> Iterator[Finding]:
+        # alias imports first, so renamed spellings can't slip past:
+        # `import time as t` -> t.monotonic(); `from time import
+        # monotonic [as m]` -> bare monotonic()/m()
+        module_aliases = {"time"}
+        func_aliases: dict[str, str] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "time":
+                        module_aliases.add(alias.asname or "time")
+            elif isinstance(node, ast.ImportFrom) \
+                    and node.module == "time":
+                for alias in node.names:
+                    if alias.name in self.DIRECT_NAMES:
+                        func_aliases[alias.asname or alias.name] = \
+                            f"time.{alias.name}"
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name):
+                spelled = func_aliases.get(node.func.id)
+                if spelled is not None:
+                    yield (node.lineno, node.col_offset,
+                           f"direct {spelled}() (imported as "
+                           f"{node.func.id}) bypasses the clock seam "
+                           "— route through clock.monotonic() or "
+                           "justify with `# lint: clock-ok <reason>`")
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            chain = _attr_chain(node.func)
+            base, _, attr = chain.rpartition(".")
+            if chain in self.DIRECT or (
+                    base in module_aliases
+                    and attr in self.DIRECT_NAMES):
+                yield (node.lineno, node.col_offset,
+                       f"direct {chain}() bypasses the clock seam "
+                       "(cluster/clock.py; file/ modules import "
+                       "chunky_bits_tpu.utils.clock) — a virtual-time "
+                       "run would silently mix timebases; route "
+                       "through clock.monotonic() or justify with "
+                       "`# lint: clock-ok <reason>`")
+            elif (node.func.attr == "time" and not node.args
+                    and chain != "time.time"
+                    and ("loop" in chain.lower() if chain
+                         else self._is_loop_call(node.func.value))):
+                # loop.time() in any spelling: a named loop variable
+                # or a get_running_loop()/get_event_loop() call result
+                # (an arbitrary call result — datetime.now().time() —
+                # is NOT a loop and must not force a bogus suppression)
+                yield (node.lineno, node.col_offset,
+                       "loop.time() bypasses the clock seam — on the "
+                       "simulator's loop it happens to be virtual, but "
+                       "production durations must come off ONE clock "
+                       "(clock.monotonic()); justify deliberate sites "
+                       "with `# lint: clock-ok <reason>`")
+
+
 #: one-line hazard descriptions for --list-rules family grouping
 FAMILY_HAZARDS = {
     "CB1xx": ("single-function invariants: bounded waits, env-flag "
               "discipline, daemon threads, narrow excepts, jit "
-              "hygiene, typing floor, metric label cardinality"),
+              "hygiene, typing floor, metric label cardinality, "
+              "clock-seam discipline"),
     "CB2xx": ("concurrency hazards of the two-plane host/async "
               "runtime: blocked loops, cross-plane handoffs, leaked "
               "tasks, loop-spanning shared state"),
@@ -485,4 +594,5 @@ ALL_RULES: tuple[Rule, ...] = (
     JitBodyHygieneRule(),
     PublicAnnotationsRule(),
     MetricLabelCardinalityRule(),
+    ClockSeamRule(),
 ) + CONCURRENCY_RULES

@@ -1,0 +1,48 @@
+"""The process-wide clock seam (canonical surface).
+
+``Clock`` / ``VirtualClock`` / ``monotonic`` / ``sleep`` / ``install``
+/ ``active`` / ``system_clock`` — every time-sensitive policy in the
+cluster and file planes resolves time through this seam so the
+deterministic cluster simulator (``chunky_bits_tpu/sim``) can swap the
+system clock for a virtual one and run thousand-node fault scenarios
+in compressed virtual time.  Lint rule CB108 (analysis/rules.py) pins
+the discipline: direct ``time.monotonic()`` / ``time.time()`` /
+``loop.time()`` reads in ``cluster/``, ``file/`` and
+``ops/batching.py`` are flagged unless they carry a
+``# lint: clock-ok <reason>`` justification (wall-clock timestamps for
+humans — access-log times, slab publish stamps — stay real
+deliberately).
+
+The implementation lives in ``chunky_bits_tpu/utils/clock.py`` and is
+re-exported here whole: ``file/`` modules must be importable without
+triggering the ``cluster`` package ``__init__`` (which imports
+``destination.py`` -> ``file.location`` and would cycle), the same
+import-cycle hygiene that keeps ``TRANSIENT_HTTP_STATUSES`` in
+``errors.py`` re-exported by ``cluster/health.py``.  Both names are
+the same module-level state: ``install`` through either rebinds the
+one active clock.
+"""
+
+from __future__ import annotations
+
+#: re-exported whole — see the module docstring for why the
+#: implementation lives on the utils side of the package graph
+from chunky_bits_tpu.utils.clock import (  # noqa: F401
+    Clock,
+    VirtualClock,
+    active,
+    install,
+    monotonic,
+    sleep,
+    system_clock,
+)
+
+__all__ = [
+    "Clock",
+    "VirtualClock",
+    "active",
+    "install",
+    "monotonic",
+    "sleep",
+    "system_clock",
+]

@@ -30,6 +30,7 @@ import asyncio
 import random
 from typing import Optional, Sequence
 
+from chunky_bits_tpu.cluster import clock as _clock
 from chunky_bits_tpu.cluster.nodes import ClusterNode, ClusterNodes
 from chunky_bits_tpu.cluster.profile import ClusterProfile, ZoneRule
 from chunky_bits_tpu.cluster.tunables import stagger_seconds
@@ -210,7 +211,7 @@ class ClusterWriter:
                     if attempt < self.state.cx.read_retries \
                             and is_transient_error(err):
                         attempt += 1
-                        await asyncio.sleep(
+                        await _clock.sleep(
                             random.uniform(0.025, 0.075) * attempt)
                         continue
                     await self.state.invalidate_index(index, err)

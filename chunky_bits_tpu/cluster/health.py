@@ -33,11 +33,12 @@ from __future__ import annotations
 
 import os
 import threading
-import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
 from urllib.parse import urlsplit
+
+from chunky_bits_tpu.cluster import clock as _clock
 
 #: re-exported for callers that think in scheduler terms; the
 #: definitions live in errors.py so file/ modules can use them without
@@ -126,6 +127,10 @@ class HealthStats:
     hedges_fired: int
     hedges_won: int
     hedges_cancelled: int
+    #: primary (non-hedge) fetches that accrued hedge budget — the
+    #: denominator of the hedge-amplification bound the simulator's
+    #: thundering-herd scenario asserts (fired <= ratio*primaries+burst)
+    primaries: int = 0
 
     def to_obj(self) -> dict:
         return {
@@ -133,6 +138,7 @@ class HealthStats:
             "hedges_fired": self.hedges_fired,
             "hedges_won": self.hedges_won,
             "hedges_cancelled": self.hedges_cancelled,
+            "primaries": self.primaries,
         }
 
     def __str__(self) -> str:
@@ -169,7 +175,7 @@ class HealthScoreboard:
     def __init__(self, hedge_ms: float = 0.0,
                  hedge_ratio: float = 0.05,
                  hedge_burst: float = 8.0,
-                 clock: Callable[[], float] = time.monotonic) -> None:
+                 clock: Callable[[], float] = _clock.monotonic) -> None:
         self._lock = threading.Lock()
         self._nodes: dict[tuple[str, str], _Node] = {}
         self._clock = clock
@@ -187,12 +193,27 @@ class HealthScoreboard:
         self.hedges_fired = 0
         self.hedges_won = 0
         self.hedges_cancelled = 0
+        self.primaries = 0
         # weakly self-register with the process metrics registry: the
         # scoreboard is already thread-safe, so a /metrics scrape just
         # takes an extra stats() snapshot
         from chunky_bits_tpu.obs.metrics import get_registry
 
         get_registry().register_source("health", self)
+
+    @property
+    def hedge_ratio(self) -> float:
+        """Budget accrued per primary fetch — the amplification
+        bound's slope.  Public so external assertions (the simulator's
+        hedge-budget verdict) read the SAME numbers the accrual uses:
+        fired <= ratio * primaries + burst."""
+        return self._hedge_ratio
+
+    @property
+    def hedge_burst(self) -> float:
+        """Token ceiling (and starting balance) — the amplification
+        bound's intercept."""
+        return self._hedge_burst
 
     # ---- recording (the location.py instrument hooks call these) ----
 
@@ -315,6 +336,7 @@ class HealthScoreboard:
     def note_primary(self) -> None:
         """A primary (non-hedge) fetch started: accrue hedge budget."""
         with self._lock:
+            self.primaries += 1
             self._hedge_tokens = min(
                 self._hedge_tokens + self._hedge_ratio,
                 self._hedge_burst)
@@ -375,4 +397,5 @@ class HealthScoreboard:
                 hedges_fired=self.hedges_fired,
                 hedges_won=self.hedges_won,
                 hedges_cancelled=self.hedges_cancelled,
+                primaries=self.primaries,
             )

@@ -91,6 +91,8 @@ from typing import TYPE_CHECKING, Optional
 
 import numpy as np
 
+from chunky_bits_tpu.cluster import clock as _clock
+from chunky_bits_tpu.cluster.health import location_key
 from chunky_bits_tpu.errors import ErasureError, LocationError
 from chunky_bits_tpu.ops.backend import KNOWN_CODES
 from chunky_bits_tpu.file.location import (
@@ -219,10 +221,24 @@ class RepairPlanner:
     ``bucket`` is the byte-rate ``TokenBucket`` repair I/O charges
     (or None — unmetered, e.g. ``--once`` CLI runs at rate 0);
     ``backend`` names the erasure backend for decode dispatches.
+
+    ``replace_after_s`` is the **re-placement escalation threshold**: a
+    victim replica whose in-place repair writes have been failing
+    continuously for this long is treated as permanently gone, and its
+    part is handed to the classic resilver to allocate a NEW location.
+    Below the threshold the planner just retries next pass — a
+    transient partition must be *waited out*, not answered with a
+    republish storm that moves every partitioned chunk somewhere else
+    (the distinction the simulator's az-outage vs correlated-failures
+    scenarios pin: partitioned nodes come back with their bytes,
+    dead disks never do).  Times run on the cluster clock seam
+    (``cluster/clock.py``), so the simulator compresses the wait.
     """
 
     def __init__(self, health=None, bucket=None,
-                 backend: Optional[str] = None) -> None:
+                 backend: Optional[str] = None,
+                 replace_after_s: float = 900.0,
+                 stale_after_s: Optional[float] = None) -> None:
         from chunky_bits_tpu.cluster.scrub import TokenBucket
 
         self.health = health
@@ -230,6 +246,24 @@ class RepairPlanner:
         # no-op), so direct planner use outside a daemon stays unmetered
         self.bucket = bucket if bucket is not None else TokenBucket(0.0)
         self.backend = backend
+        self.replace_after_s = max(float(replace_after_s), 0.0)
+        #: the continuity bound: a gap between failures longer than
+        #: this RESETS the window below.  Defaults to replace_after_s;
+        #: callers whose retry cadence is slower than the threshold
+        #: (ScrubDaemon passes max(replace_after_s, 2 x pass interval))
+        #: must widen it, or consecutive-pass failures would always
+        #: look stale and escalation could never fire.
+        self.stale_after_s = max(
+            float(stale_after_s) if stale_after_s is not None else 0.0,
+            self.replace_after_s)
+        #: node key -> (first, last) failure times of in-place repair
+        #: writes — the persistence memory the re-placement escalation
+        #: reads.  Cleared by any later success; a gap between
+        #: failures longer than ``stale_after_s`` RESETS the window,
+        #: so a recovered node's ancient stamp can never make a future
+        #: one-pass blip escalate instantly.  Bounded by node count.
+        self._unwritable_since: dict[tuple[str, str],
+                                     tuple[float, float]] = {}
         # counters are read by /metrics scrapes and /scrub/status
         # handlers, possibly from other threads than the repair loop's;
         # one dict per code so every family carries the code label
@@ -358,12 +392,25 @@ class RepairPlanner:
         repaired = failures = 0
         for victim in victims:
             await self.bucket.take(len(payload))
+            key = location_key(victim)
             try:
                 await victim.write(payload, overwrite_cx)
             except LocationError:
-                # node still down/full: counted, retried next pass
+                # node still down/full: counted, retried next pass —
+                # and remembered, so a node that STAYS unwritable past
+                # replace_after_s escalates to re-placement.  A stale
+                # window (no failure observed for replace_after_s)
+                # restarts at now: "continuously" means failures keep
+                # recurring, not "failed once, ever"
                 failures += 1
+                now = _clock.monotonic()
+                prev = self._unwritable_since.get(key)
+                if prev is None or now - prev[1] > self.stale_after_s:
+                    self._unwritable_since[key] = (now, now)
+                else:
+                    self._unwritable_since[key] = (prev[0], now)
                 continue
+            self._unwritable_since.pop(key, None)
             self._bump(code, bytes_written=len(payload))
             repaired += 1
         return repaired, failures
@@ -613,7 +660,7 @@ class RepairPlanner:
             if hi == ci or not good[hi]:
                 continue
             locs = [loc for loc in self._order(good[hi])
-                    if loc.is_local() or loc.is_slab()]
+                    if loc.is_local() or loc.is_slab() or loc.is_sim()]
             if locs:
                 candidates.append((hi, locs))
         if len(candidates) < coder.helpers:
@@ -659,6 +706,38 @@ class RepairPlanner:
             self._bump("pm-msr", bytes_rebuilt=part.chunksize,
                        ranges_rebuilt=1)
         return (r, f)
+
+    def _maybe_replace(self, code: str, chunks: list,
+                       corrupt: list, missing: list,
+                       fallback: bool) -> bool:
+        """The re-placement escalation (see the class docstring): when
+        any victim of this part has been unwritable continuously for
+        ``replace_after_s``, hand the part to the classic resilver so
+        the replica gets a NEW home.  Never fires for nodes that came
+        back (success pops the memory) and never below the threshold —
+        a transient partition is waited out in place.  The key stays
+        after firing (every part with a replica on the dead node must
+        escalate, and they arrive one repair_part call at a time);
+        staleness is handled on the RECORDING side: a gap between
+        failures longer than the threshold resets the window, so the
+        entry can never act as a "failed once, ever" stamp."""
+        if fallback or self.replace_after_s <= 0 \
+                or not self._unwritable_since:
+            return fallback
+        now = _clock.monotonic()
+        for ci in range(len(chunks)):
+            for loc in corrupt[ci] + missing[ci]:
+                window = self._unwritable_since.get(location_key(loc))
+                if (window is not None
+                        and now - window[0] >= self.replace_after_s
+                        # the streak must still be live: a window whose
+                        # last failure is older than the continuity
+                        # bound is stale evidence, not a
+                        # continuously-dead node
+                        and now - window[1] <= self.stale_after_s):
+                    self._bump(code, plans_fallback=1)
+                    return True
+        return fallback
 
     # ---- the entry point ----
 
@@ -718,6 +797,8 @@ class RepairPlanner:
         lost = [ci for ci in range(len(chunks))
                 if not good[ci] and (corrupt[ci] or missing[ci])]
         if not lost:
+            fallback = self._maybe_replace(code, chunks, corrupt,
+                                           missing, fallback)
             return PartRepairOutcome(repaired, failures, fallback)
 
         # 2a. msr regeneration: a pm-msr part that lost exactly ONE
@@ -731,6 +812,8 @@ class RepairPlanner:
             if res is not None:
                 repaired += res[0]
                 failures += res[1]
+                fallback = self._maybe_replace(code, chunks, corrupt,
+                                               missing, fallback)
                 return PartRepairOutcome(repaired, failures, fallback)
 
         # 2b. decode plans
@@ -818,4 +901,6 @@ class RepairPlanner:
                     ranges_rebuilt=len(ranges_by_ci[ci]))
             repaired += r
             failures += f
+        fallback = self._maybe_replace(code, chunks, corrupt, missing,
+                                       fallback)
         return PartRepairOutcome(repaired, failures, fallback)

@@ -42,10 +42,10 @@ import asyncio
 import json
 import logging
 import threading
-import time
 from dataclasses import dataclass
 from typing import Optional
 
+from chunky_bits_tpu.cluster import clock as _clock
 from chunky_bits_tpu.errors import ChunkyBitsError, LocationError
 
 log = logging.getLogger("chunky_bits_tpu.scrub")
@@ -79,10 +79,10 @@ class TokenBucket:
     def __init__(self, rate: float) -> None:
         self.rate = max(float(rate), 0.0)
         self._balance = self.rate  # start with one second of burst
-        self._last = time.monotonic()
+        self._last = _clock.monotonic()
 
     def _accrue(self) -> None:
-        now = time.monotonic()
+        now = _clock.monotonic()
         self._balance = min(
             self._balance + (now - self._last) * self.rate, self.rate)
         self._last = now
@@ -94,7 +94,7 @@ class TokenBucket:
         self._balance -= nbytes
         while self._balance < 0:
             wait = min(-self._balance / self.rate, self.MAX_SLEEP)
-            await asyncio.sleep(wait)
+            await _clock.sleep(wait)
             self._accrue()
 
 
@@ -177,12 +177,19 @@ class ScrubDaemon:
     the pass makes — the per-read byte accounting bench --config 11
     measures helper traffic with; None (the default) keeps the fused
     no-profiler fast paths.
+
+    ``replace_after_s`` is the planner's re-placement escalation
+    threshold (cluster/repair.py): a replica unwritable for this long
+    is treated as permanently lost and its part resilvered to a NEW
+    location; below it, in-place repair retries next pass (transient
+    partitions are waited out, never answered with a republish storm).
     """
 
     def __init__(self, cluster, bytes_per_sec: Optional[float] = None,
                  interval_seconds: float = 60.0, repair: bool = True,
                  profile_name: Optional[str] = None,
-                 planner: bool = True, profiler=None) -> None:
+                 planner: bool = True, profiler=None,
+                 replace_after_s: float = 900.0) -> None:
         self.cluster = cluster
         rate = (cluster.tunables.scrub_bytes_per_sec
                 if bytes_per_sec is None else float(bytes_per_sec))
@@ -198,7 +205,15 @@ class ScrubDaemon:
             self._planner: Optional[RepairPlanner] = RepairPlanner(
                 health=cluster.health_scoreboard(),
                 bucket=self._bucket,
-                backend=cluster.tunables.backend)
+                backend=cluster.tunables.backend,
+                replace_after_s=replace_after_s,
+                # the continuity bound must out-span the retry cadence:
+                # failures recur once per pass, so with interval >
+                # replace_after_s every pass would otherwise look like
+                # a fresh (stale-reset) streak and escalation could
+                # never fire
+                stale_after_s=max(replace_after_s,
+                                  2.0 * float(interval_seconds)))
         else:
             self._planner = None
         self._task: Optional[asyncio.Task] = None
@@ -483,7 +498,7 @@ class ScrubDaemon:
         namespace scale holding every parsed FileReference would be
         unbounded memory AND guarantee every repair republishes
         hours-stale metadata."""
-        started = time.monotonic()
+        started = _clock.monotonic()
         cx = self.cluster.tunables.location_context()
         if self.profiler is not None:
             # per-read byte accounting for the pass (bench --config 11
@@ -515,7 +530,7 @@ class ScrubDaemon:
             await self._scrub_ref(path, ref, cx, pipe, snapshot)
         with self._lock:
             self._passes += 1
-            self._last_pass_seconds = time.monotonic() - started
+            self._last_pass_seconds = _clock.monotonic() - started
         return self.stats()
 
     # ---- daemon lifetime ----
@@ -537,7 +552,7 @@ class ScrubDaemon:
                 # chunks via the bucket; give the loop one tick anyway
                 await asyncio.sleep(0)
                 continue
-            await asyncio.sleep(self.interval_seconds)
+            await _clock.sleep(self.interval_seconds)
 
     def start(self) -> None:
         """Start the continuous loop on the running event loop.

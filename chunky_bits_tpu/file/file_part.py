@@ -16,7 +16,6 @@ from __future__ import annotations
 import asyncio
 import enum
 import random
-import time
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Any, Iterator, Optional
 
@@ -37,6 +36,12 @@ from chunky_bits_tpu.file.location import Location, LocationContext, \
 from chunky_bits_tpu.obs import tracing as obs_tracing
 from chunky_bits_tpu.ops import ErasureCoder, get_coder
 from chunky_bits_tpu.utils import aio
+
+#: the clock seam (canonical surface cluster/clock.py; imported from
+#: utils/ for the file->cluster import-cycle hygiene): hedge and
+#: straggler delays, retry backoff, and trace spans all read it so the
+#: simulator's virtual timebase drives them
+from chunky_bits_tpu.utils import clock as _clock
 
 if TYPE_CHECKING:  # typing-only: none of these is needed at import time
     from chunky_bits_tpu.file.chunk_cache import ChunkCache
@@ -412,7 +417,7 @@ class FilePart:
                             or not is_transient_error(err):
                         raise
                     attempt += 1
-                    await asyncio.sleep(
+                    await _clock.sleep(
                         random.uniform(0.025, 0.075) * attempt)
 
         def _corrupt(failures: list, location: Location,
@@ -459,8 +464,7 @@ class FilePart:
                 location = locs[next_i]
                 next_i += 1
                 task = asyncio.ensure_future(read_one(chunk, location))
-                pending[task] = (location, is_hedge,
-                                 asyncio.get_running_loop().time())
+                pending[task] = (location, is_hedge, _clock.monotonic())
 
             spawn(is_hedge=False)
             try:
@@ -509,7 +513,7 @@ class FilePart:
                     health.hedge_cancelled(
                         sum(1 for _l, is_h, _t in pending.values()
                             if is_h))
-                    now = asyncio.get_running_loop().time()
+                    now = _clock.monotonic()
                     for task, (location, _h, t0) in pending.items():
                         task.cancel()
                         # a cancelled loser ran at least (now - t0)
@@ -532,13 +536,13 @@ class FilePart:
             failures: list[tuple[Location, str]] = []
             if health is not None:
                 health.note_primary()  # hedge-budget accrual
-            t0 = time.monotonic()
+            t0 = _clock.monotonic()
             if hedging and len(chunk.locations) > 1:
                 data = await fetch_hedged(chunk, failures)
             else:
                 data = await fetch_serial(chunk, failures)
             obs_tracing.record_span(
-                "chunk_fetch", "network", t0, time.monotonic() - t0,
+                "chunk_fetch", "network", t0, _clock.monotonic() - t0,
                 "ok" if data is not None else "miss")
             if failures and cx.profiler is not None:
                 for location, err in failures:
@@ -642,12 +646,12 @@ class FilePart:
                 np.frombuffer(s, dtype=np.uint8) if s is not None else None
                 for s in slots
             ]
-            t0 = time.monotonic()
+            t0 = _clock.monotonic()
             arrays = await _reconstruct(arrays, d, p, coder, backend,
                                         batcher, data_only=True,
                                         code=self.code)
             obs_tracing.record_span("reconstruct", "compute", t0,
-                                    time.monotonic() - t0)
+                                    _clock.monotonic() - t0)
             # rebuilt rows stay as buffers (memoryview over the array) —
             # every consumer downstream (join, hashing, socket/stdout
             # writes) takes buffer objects, so no tobytes copy

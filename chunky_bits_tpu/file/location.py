@@ -20,10 +20,17 @@ import errno
 import io
 import os
 import re
-import time
 from dataclasses import dataclass, field, replace
 from typing import Optional
 from urllib.parse import quote, urlsplit, urlunsplit
+
+#: the clock seam (cluster/clock.py is the canonical surface; the
+#: implementation lives in utils/ so file/ modules can import it
+#: without triggering the cluster package __init__ — import-cycle
+#: hygiene, same as errors.py).  Every latency the health scoreboard
+#: and profiler see comes off this clock, so the simulator's virtual
+#: timebase flows through unchanged.
+from chunky_bits_tpu.utils import clock as _clock
 
 from chunky_bits_tpu.errors import (
     HttpStatusError,
@@ -393,8 +400,9 @@ class _ProfiledReader:
 class Location:
     """A storage address; value semantics, string serde."""
 
-    kind: str  # "local" | "http" | "slab"
-    target: str  # filesystem path, full URL, or slab <root>/<name> path
+    kind: str  # "local" | "http" | "slab" | "sim"
+    target: str  # filesystem path, full URL, slab <root>/<name>, or
+    #            sim <fabric>/<node>/<chunk> path
     range: Range = field(default_factory=Range)
 
     # ---- construction / parsing ----
@@ -424,6 +432,17 @@ class Location:
                 raise LocationParseError(
                     f"invalid slab location: {rest!r}")
             return Location("slab", path, rng)
+        if rest.startswith("sim:"):
+            # simulated storage node (sim/fabric.py): the path names
+            # <fabric>/<node>[/<chunk>] — bytes live in the in-process
+            # fabric registry, resolved lazily exactly like slab:
+            path = rest[len("sim:"):]
+            if not path:
+                raise LocationParseError("empty sim location")
+            if "://" in path.split("/")[0]:
+                raise LocationParseError(
+                    f"invalid sim location: {rest!r}")
+            return Location("sim", path, rng)
         if "://" in rest.split("/")[0]:
             raise LocationParseError(f"invalid location scheme: {rest!r}")
         if not rest:
@@ -439,13 +458,18 @@ class Location:
         return Location("slab", str(path), rng or Range())
 
     @staticmethod
+    def sim(path: str, rng: Optional[Range] = None) -> "Location":
+        return Location("sim", str(path), rng or Range())
+
+    @staticmethod
     def http(url: str, rng: Optional[Range] = None) -> "Location":
         if not (url.startswith("http://") or url.startswith("https://")):
             raise LocationParseError(f"not an http url: {url!r}")
         return Location("http", url, rng or Range())
 
     def __str__(self) -> str:
-        prefix = "slab:" if self.is_slab() else ""
+        prefix = "slab:" if self.is_slab() else \
+            "sim:" if self.is_sim() else ""
         if self.range.is_specified():
             return f"{self.range}{prefix}{self.target}"
         return f"{prefix}{self.target}"
@@ -458,6 +482,9 @@ class Location:
 
     def is_slab(self) -> bool:
         return self.kind == "slab"
+
+    def is_sim(self) -> bool:
+        return self.kind == "sim"
 
     def with_range(self, rng: Range) -> "Location":
         return replace(self, range=rng)
@@ -477,6 +504,16 @@ class Location:
         from chunky_bits_tpu.file import slab
 
         return slab.get_store(self._slab_parts()[0])
+
+    # ---- sim addressing (sim/fabric.py) ----
+
+    def _sim_node(self) -> tuple[object, str]:
+        """(simulated node, chunk name) for a sim chunk address.  The
+        import is lazy and only runs for sim-kind locations — production
+        paths never load the simulator (the slab: discipline)."""
+        from chunky_bits_tpu.sim import fabric as sim_fabric
+
+        return sim_fabric.resolve(self.target)
 
     def slab_extent(self) -> Optional[tuple[str, int, int]]:
         """(slab file path, offset, length) of a live packed chunk, or
@@ -567,21 +604,21 @@ class Location:
         stream at EOF/close/error — the streaming-path hook the reference
         leaves as TODO (src/file/location.rs:119)."""
         cx = cx or default_context()
-        start = time.monotonic()
+        start = _clock.monotonic()
         try:
             base = await self._open_reader(cx)
         except LocationError as err:
             # stream-open failure: one health sample (latency to the
             # error), one profiler entry
             if cx.health is not None:
-                cx.health.record(self, False, time.monotonic() - start)
+                cx.health.record(self, False, _clock.monotonic() - start)
             if cx.profiler is not None:
                 cx.profiler.log_read(False, str(err), self, 0, start)
             raise
         if cx.health is not None:
             # the scoreboard times the open (time-to-first-byte proxy);
             # stream duration depends on the consumer, not the node
-            cx.health.record(self, True, time.monotonic() - start)
+            cx.health.record(self, True, _clock.monotonic() - start)
         if cx.profiler is None:
             return base
         return _ProfiledReader(base, cx.profiler, self, start)
@@ -630,6 +667,21 @@ class Location:
                     aio.TakeReader(base, min(avail, rng.length)),
                     rng.length)
             return aio.TakeReader(base, min(rng.length, avail))
+        if self.is_sim():
+            # simulated node: the fabric applies latency/fault/bandwidth
+            # models and returns the (ranged) payload; range semantics
+            # mirror the local branch (short ranges read short,
+            # extend_zeros pads)
+            if rng.start < 0 or (rng.length is not None
+                                 and rng.length < 0):
+                raise LocationError(
+                    f"negative range {rng} on sim location")
+            node, name = self._sim_node()
+            data = await node.read(name, rng.start, rng.length)
+            base = aio.BytesReader(data)
+            if rng.length is not None and rng.extend_zeros:
+                return aio.ZeroExtendReader(base, rng.length)
+            return base
         if self.is_local():
             def _open_local():
                 f = open(self.target, "rb")
@@ -692,7 +744,7 @@ class Location:
         """Read the full (ranged) content; profiler-hooked
         (src/file/location.rs:95-113)."""
         cx = cx or default_context()
-        start = time.monotonic()
+        start = _clock.monotonic()
         if cx.health is not None:
             cx.health.begin(self)
         try:
@@ -714,7 +766,7 @@ class Location:
                 await aio.close_reader(reader)
         except LocationError as err:
             if cx.health is not None:
-                cx.health.finish(self, False, time.monotonic() - start)
+                cx.health.finish(self, False, _clock.monotonic() - start)
             if cx.profiler is not None:
                 cx.profiler.log_read(False, str(err), self, 0, start)
             raise
@@ -726,7 +778,7 @@ class Location:
                 cx.health.finish(self, None, None)
             raise
         if cx.health is not None:
-            cx.health.finish(self, True, time.monotonic() - start)
+            cx.health.finish(self, True, _clock.monotonic() - start)
         if cx.profiler is not None:
             cx.profiler.log_read(True, None, self, len(out), start)
         return out
@@ -776,12 +828,12 @@ class Location:
             location = self
 
             def _map_slab() -> Optional[memoryview]:
-                t0 = time.monotonic()
+                t0 = _clock.monotonic()
                 view = store.map_view(root_name[1], rng.start or 0,
                                       rng.length)
                 if view is not None and health is not None:
                     health.record(location, True,
-                                  time.monotonic() - t0)
+                                  _clock.monotonic() - t0)
                 return view
 
             return _map_slab
@@ -789,7 +841,7 @@ class Location:
         def _map() -> Optional[memoryview]:
             import mmap
 
-            t0 = time.monotonic()
+            t0 = _clock.monotonic()
             try:
                 with open(self.target, "rb") as f:
                     mm = mmap.mmap(f.fileno(), 0,
@@ -809,7 +861,7 @@ class Location:
                 # a None return above is "fast path doesn't apply", not
                 # a node failure — the generic read re-records it; only
                 # a served view is a health sample
-                health.record(self, True, time.monotonic() - t0)
+                health.record(self, True, _clock.monotonic() - t0)
             return memoryview(mm)[start:end]
 
         return _map
@@ -823,14 +875,14 @@ class Location:
         cx = cx or default_context()
         if self.range.is_specified():
             raise WriteToRangeError()
-        start = time.monotonic()
+        start = _clock.monotonic()
         if cx.health is not None:
             cx.health.begin(self)
         try:
             if cx.on_conflict == IGNORE and await self.file_exists(cx):
                 if cx.health is not None:
                     cx.health.finish(self, True,
-                                     time.monotonic() - start)
+                                     _clock.monotonic() - start)
                 if cx.profiler is not None:
                     cx.profiler.log_write(True, None, self, len(data), start)
                 return
@@ -844,6 +896,9 @@ class Location:
                     await asyncio.to_thread(store.append, name, data)
                 except OSError as err:
                     raise LocationError(str(err)) from err
+            elif self.is_sim():
+                node, name = self._sim_node()
+                await node.write(name, data)
             elif self.is_local():
                 try:
                     await _atomic_publish(self.target, data)
@@ -863,7 +918,7 @@ class Location:
                     raise HttpStatusError(resp.status, self.target)
         except LocationError as err:
             if cx.health is not None:
-                cx.health.finish(self, False, time.monotonic() - start)
+                cx.health.finish(self, False, _clock.monotonic() - start)
             if cx.profiler is not None:
                 cx.profiler.log_write(False, str(err), self, len(data), start)
             raise
@@ -872,7 +927,7 @@ class Location:
                 cx.health.finish(self, None, None)  # cancelled: no verdict
             raise
         if cx.health is not None:
-            cx.health.finish(self, True, time.monotonic() - start)
+            cx.health.finish(self, True, _clock.monotonic() - start)
         if cx.profiler is not None:
             cx.profiler.log_write(True, None, self, len(data), start)
 
@@ -884,7 +939,7 @@ class Location:
         cx = cx or default_context()
         if cx.profiler is None and cx.health is None:
             return await self._write_from_reader_impl(reader, cx)
-        start = time.monotonic()
+        start = _clock.monotonic()
         # Count consumed bytes on the reader side so a stream that fails
         # mid-body still profiles its partial progress.
         counted = aio.CountingReader(reader)
@@ -894,7 +949,7 @@ class Location:
             total = await self._write_from_reader_impl(counted, cx)
         except LocationError as err:
             if cx.health is not None:
-                cx.health.finish(self, False, time.monotonic() - start)
+                cx.health.finish(self, False, _clock.monotonic() - start)
             if cx.profiler is not None:
                 cx.profiler.log_write(False, str(err), self,
                                       counted.total, start)
@@ -904,7 +959,7 @@ class Location:
                 cx.health.finish(self, None, None)  # cancelled: no verdict
             raise
         if cx.health is not None:
-            cx.health.finish(self, True, time.monotonic() - start)
+            cx.health.finish(self, True, _clock.monotonic() - start)
         if cx.profiler is not None:
             cx.profiler.log_write(True, None, self, total, start)
         return total
@@ -915,24 +970,34 @@ class Location:
             raise WriteToRangeError()
         if cx.on_conflict == IGNORE and await self.file_exists(cx):
             return 0
-        if self.is_slab():
-            # the slab journal commits (name -> extent) in one record,
-            # so the whole body must be known before publication:
-            # buffer the stream (chunk payloads are bounded by the
-            # profile's chunksize) and append once
+        async def drain() -> bytes:
+            # whole-body buffering for the one-record publication
+            # shapes below (chunk payloads are bounded by the
+            # profile's chunksize)
             chunks: list[bytes] = []
             while True:
                 data = await reader.read(1 << 20)
                 if not data:
                     break
                 chunks.append(data)
-            payload = b"".join(chunks)
+            return b"".join(chunks)
+
+        if self.is_slab():
+            # the slab journal commits (name -> extent) in one record,
+            # so the whole body must be known before publication
+            payload = await drain()
             root, name = self._slab_parts()
             store = self._slab_store()
             try:
                 await asyncio.to_thread(store.append, name, payload)
             except OSError as err:
                 raise LocationError(str(err)) from err
+            return len(payload)
+        if self.is_sim():
+            # one fabric publication per chunk (mirrors the slab shape)
+            payload = await drain()
+            node, name = self._sim_node()
+            await node.write(name, payload)
             return len(payload)
         if self.is_local():
             try:
@@ -988,6 +1053,9 @@ class Location:
                 await asyncio.to_thread(store.mark_dead, name)
             except OSError as err:
                 raise LocationError(str(err)) from err
+        elif self.is_sim():
+            node, name = self._sim_node()
+            await node.delete(name)
         elif self.is_local():
             try:
                 await asyncio.to_thread(os.remove, self.target)
@@ -1012,6 +1080,9 @@ class Location:
             store = self._slab_store()
             name = self._slab_parts()[1]
             return await asyncio.to_thread(store.lookup, name) is not None
+        if self.is_sim():
+            node, name = self._sim_node()
+            return await node.exists(name)
         if self.is_local():
             return await asyncio.to_thread(os.path.exists, self.target)
         self._check_scheme(cx)
@@ -1035,6 +1106,9 @@ class Location:
                 raise LocationError(
                     f"no live chunk {name!r} in slab store")
             return ext.length
+        if self.is_sim():
+            node, name = self._sim_node()
+            return await node.length(name)
         if self.is_local():
             try:
                 st = await asyncio.to_thread(os.stat, self.target)

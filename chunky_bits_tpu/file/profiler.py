@@ -30,13 +30,19 @@ Two extensions beyond the reference:
 from __future__ import annotations
 
 import threading
-import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Optional
 
 from chunky_bits_tpu.obs import metrics as obs_metrics
 from chunky_bits_tpu.obs import tracing as obs_tracing
+
+#: the clock seam (canonical surface cluster/clock.py; utils-side
+#: import for cycle hygiene): the ``start_time`` values callers pass
+#: to log_read/log_write come off this clock, so the matching ``end``
+#: read must too — mixing timebases would corrupt every duration the
+#: moment the simulator installs a virtual clock
+from chunky_bits_tpu.utils import clock as _clock
 
 
 def percentile(sorted_values: list, q: float) -> float:
@@ -294,7 +300,7 @@ class Profiler:
 
     def log_read(self, ok: bool, error: Optional[str], location,
                  length: int, start_time: float) -> None:
-        end = time.monotonic()
+        end = _clock.monotonic()
         entry = ResultLog("read", ok, error, location, length,
                           start_time, end)
         with self._lock:
@@ -309,7 +315,7 @@ class Profiler:
 
     def log_write(self, ok: bool, error: Optional[str], location,
                   length: int, start_time: float) -> None:
-        end = time.monotonic()
+        end = _clock.monotonic()
         entry = ResultLog("write", ok, error, location, length,
                           start_time, end)
         with self._lock:

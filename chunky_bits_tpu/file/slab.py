@@ -383,6 +383,9 @@ class SlabStore:
                 offset = f.tell()
                 f.write(view)
                 f.flush()
+            # lint: clock-ok wall-clock publish stamp for humans (the
+            # journal's `t` field is operator forensics, never a
+            # duration — it must stay real even inside a simulation)
             published = time.time()
             record = {"o": "p", "n": name, "s": slab, "f": offset,
                       "l": len(view), "t": published}

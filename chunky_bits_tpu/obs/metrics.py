@@ -313,6 +313,21 @@ def get_registry() -> MetricsRegistry:
     return _REGISTRY
 
 
+def swap_registry(registry: Optional[MetricsRegistry]
+                  ) -> Optional[MetricsRegistry]:
+    """Swap the process registry for ``registry`` (None = a fresh one
+    on next :func:`get_registry`); returns the previous registry so
+    callers can restore it.  The cluster simulator brackets every
+    scenario run with this so two runs of the same seed observe — and
+    can compare — exactly the counters that run produced; production
+    code never calls it."""
+    global _REGISTRY
+    with _REGISTRY_LOCK:
+        previous = _REGISTRY
+        _REGISTRY = registry
+    return previous
+
+
 # ---- event-recorded helpers (Profiler / gateway call these) ----
 
 #: closed label sets for the request/IO series (CB107: anything outside

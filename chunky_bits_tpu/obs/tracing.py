@@ -34,8 +34,15 @@ import heapq
 import itertools
 import os
 import threading
-import time
 from typing import Optional
+
+#: the clock seam (canonical surface cluster/clock.py; utils-side
+#: import for cycle hygiene): span starts arrive off this clock
+#: (file_part.py, gateway middleware), so the trace birth stamp the
+#: offsets subtract from must come off the SAME clock — inside a
+#: virtual-time simulation a real-clock t0 would turn every start_ms
+#: into timebase-mixed garbage
+from chunky_bits_tpu.utils import clock as _clock
 
 #: the active trace for this context; None = tracing off / untraced
 #: request.  A ContextVar, not module state: every asyncio task gets
@@ -83,15 +90,15 @@ class Trace:
 
     def __init__(self, trace_id: str) -> None:
         self.trace_id = trace_id
-        self.t0 = time.monotonic()
+        self.t0 = _clock.monotonic()
         self.spans: list[Span] = []
         self.dropped_spans = 0
         self._lock = threading.Lock()
 
     def add(self, name: str, plane: str, start: float, duration: float,
             outcome: str = "ok") -> None:
-        """Record one span; ``start`` is a ``time.monotonic`` stamp
-        (converted to ms offset from the trace's birth)."""
+        """Record one span; ``start`` is a clock-seam ``monotonic()``
+        stamp (converted to ms offset from the trace's birth)."""
         span = Span(name, plane, (start - self.t0) * 1000.0,
                     duration * 1000.0, outcome)
         with self._lock:

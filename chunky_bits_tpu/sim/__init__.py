@@ -1,0 +1,37 @@
+"""Deterministic cluster simulator.
+
+Thousand-node fault scenarios in compressed virtual time, with the
+metrics registry as the observer (ROADMAP item 5 — the
+scenario-diversity axis of the north star).  Three coordinated pieces:
+
+* ``sim/loop.py`` — a virtual-time asyncio event loop: when nothing is
+  runnable and no host-thread work is in flight, time jumps straight to
+  the next timer, so a 60-minute scrub pass runs in milliseconds of
+  wall time.  ``sim.run(coro)`` is the entry point: it builds the loop,
+  installs a :class:`chunky_bits_tpu.utils.clock.VirtualClock` through
+  the process-wide clock seam (``cluster/clock.py``), and tears both
+  down asyncio.run-style (no leaked tasks — the SANITIZE=1 contract).
+* ``sim/fabric.py`` — the fault-injection node plane: in-process
+  simulated storage nodes behind the existing ``Location`` surface
+  (the ``sim:`` kind — the same lazy-dispatch trick as ``slab:``),
+  each with a distribution-driven latency model (lognormal body +
+  configurable tail), a fault state machine (healthy → slow → erroring
+  → partitioned → dead → recovering), zone topology, and byte-accounted
+  virtual bandwidth.
+* ``sim/scenario.py`` — the scenario engine: scripted timelines (AZ
+  outage, rolling restart, thundering herd, correlated disk failures,
+  flapping node, slow-leak corruption) over a generated namespace,
+  asserting convergence invariants and emitting a seed-reproducible
+  event trace + metrics snapshot (same seed ⇒ byte-identical trace —
+  pinned by tests/test_sim.py).
+
+Production code paths import NOTHING from this package: the clock seam
+defaults to the system clock, and ``file/location.py``'s ``sim:``
+branches import ``sim.fabric`` lazily, only when a sim location is
+actually touched (exactly like the ``slab:`` branches and
+``file/slab.py``).  Bench ``--config 14`` is the scenario-suite runner.
+"""
+
+from chunky_bits_tpu.sim.loop import VirtualTimeLoop, run  # noqa: F401
+
+__all__ = ["VirtualTimeLoop", "run"]

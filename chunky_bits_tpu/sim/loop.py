@@ -1,0 +1,234 @@
+"""Virtual-time asyncio event loop: the simulator's timebase.
+
+A ``SelectorEventLoop`` subclass whose ``time()`` is a virtual float
+that only advances when the loop would otherwise *wait*: the wrapped
+selector first polls the real file descriptors with timeout 0 (the
+self-pipe that ``call_soon_threadsafe`` writes, any real sockets a test
+mixes in), and only when nothing is ready, no host-thread work is in
+flight, and a timer is scheduled does it jump virtual time straight to
+that timer.  A 60-minute scrub interval therefore costs one callback
+dispatch of wall time, while every duration, cooldown, EWMA decay and
+budget accrual measured through the clock seam (``cluster/clock.py``)
+agrees on the same virtual timebase.
+
+**Real work still completes.**  Filesystem hops (``asyncio.to_thread``,
+``aio.open_in_thread``) run on real threads; the loop tracks them by
+overriding ``run_in_executor`` and refuses to advance virtual time
+while any are outstanding — it blocks in a *bounded* real select slice
+(``_REAL_WAIT_SLICE``) until the completion lands on the self-pipe.
+Thread work thus takes **zero virtual time**, which is exactly the
+semantics the scenarios need: the only virtual durations are the ones
+the fault models inject.  (Host-pipeline jobs above its 128 KiB inline
+bound complete the same way but are not *tracked*; scenario payloads
+stay under the bound so virtual time can never jump over an in-flight
+hash — see sim/scenario.py.)
+
+**Determinism.**  Given a seeded scenario, callback order is the loop's
+own FIFO ready queue and timer heap — no wall-clock jitter enters the
+schedule, because real-time effects (thread completions) are absorbed
+at zero virtual width before any timer may fire.  tests/test_sim.py
+pins byte-identical event traces across runs of the same seed.
+
+**Sanitizer.**  ``run()`` instruments the loop with the active runtime
+sanitizer (watchdog heartbeat, task registry) when one is installed —
+reached via ``sys.modules`` like every hot-path hook, so the off path
+imports nothing — and tears down asyncio.run-style: cancel + await
+every remaining task, shutdown async generators and the default
+executor, close the loop.  The SANITIZE=1 tier-1 leg runs the sim
+tests with 0 leaked tasks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import selectors
+import sys
+import threading
+from typing import Any, Callable, Optional
+
+from chunky_bits_tpu.utils import clock as clock_mod
+
+__all__ = ["VirtualTimeLoop", "run"]
+
+#: bound on one real select slice while host threads are in flight (or
+#: while the loop waits on real FDs with no timer armed): completions
+#: wake the loop immediately through the self-pipe; the slice only caps
+#: how long a *stuck* thread can keep the simulator unresponsive to a
+#: stop request
+_REAL_WAIT_SLICE = 0.2
+
+
+class _VirtualSelector:
+    """Selector facade that converts "would block" into virtual-time
+    jumps.  Wraps the loop's real selector; every method except
+    ``select`` passes straight through."""
+
+    def __init__(self, base: selectors.BaseSelector,
+                 loop: "VirtualTimeLoop") -> None:
+        self._base = base
+        self._loop = loop
+
+    def select(self, timeout: Optional[float] = None) -> list:
+        # Real readiness always wins: the self-pipe (threadsafe wakeups,
+        # thread completions, watchdog heartbeats) and any real sockets
+        # are serviced before time may move.
+        events = self._base.select(0)
+        if events or timeout == 0:
+            return events
+        if self._loop._external_pending():
+            # host-thread work in flight: wait for it in REAL time —
+            # virtual time must not jump over an unfinished disk read.
+            # The completion's call_soon_threadsafe write wakes the
+            # select immediately; the slice bounds a stuck thread.
+            wait = _REAL_WAIT_SLICE if timeout is None \
+                else min(timeout, _REAL_WAIT_SLICE)
+            return self._base.select(wait)
+        if timeout is None:
+            # No timers, nothing ready, no threads: the loop is waiting
+            # on real FDs (a test mixing real sockets in) or plainly
+            # stuck — either way only real time can resolve it.  Wait
+            # in bounded slices so the loop stays interruptible
+            # (degrade, never hang).
+            return self._base.select(_REAL_WAIT_SLICE)
+        # Quiescent with a timer armed: this is the compression step —
+        # jump straight to the timer.
+        self._loop._advance(timeout)
+        return []
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._base, name)
+
+
+class VirtualTimeLoop(asyncio.SelectorEventLoop):
+    """The virtual-time loop; see the module docstring.  Construct via
+    :func:`run` (which also installs the clock seam's VirtualClock) —
+    a bare instance still works as a plain loop whose ``time()``
+    happens to be virtual."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._virtual_now = 0.0
+        # external (host-thread) work accounting: incremented on the
+        # loop thread at submit; decremented by the wrapped future's
+        # completion callback, which may run on a worker thread — hence
+        # the lock (a bare int += is GIL-atomic today, but the contract
+        # should not hang off that)
+        self._ext_lock = threading.Lock()
+        self._ext_jobs = 0
+        # ONE worker, FIFO: thread hops complete in submission order,
+        # so their zero-virtual-width completions interleave the ready
+        # queue identically on every run of the same seed (the
+        # determinism the trace pin relies on).  Throughput is
+        # irrelevant here — thread work takes zero virtual time either
+        # way.  Shut down by run()'s teardown, never by interpreter
+        # exit with work parked (the jobs are bounded local file I/O).
+        self._serial_executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="cb-sim-io")
+        self._selector = _VirtualSelector(self._selector, self)
+
+    # ---- virtual time ----
+
+    def time(self) -> float:
+        return self._virtual_now
+
+    def _advance(self, seconds: float) -> None:
+        self._virtual_now += seconds
+
+    # ---- external (threaded) work tracking ----
+
+    def _external_pending(self) -> bool:
+        with self._ext_lock:
+            return self._ext_jobs > 0
+
+    def _external_done(self, _fut: object) -> None:
+        with self._ext_lock:
+            self._ext_jobs -= 1
+
+    def run_in_executor(self, executor: Any, func: Callable, *args: Any):
+        if executor is None:
+            executor = self._serial_executor
+        fut = super().run_in_executor(executor, func, *args)
+        with self._ext_lock:
+            self._ext_jobs += 1
+        # the wrapped asyncio future completes via the loop (the
+        # self-pipe wakeup is the signal the selector blocks for), so
+        # the decrement can never land "early" — virtual time stays
+        # frozen until the result is deliverable
+        fut.add_done_callback(self._external_done)
+        return fut
+
+
+def _sanitizer():
+    """The active runtime sanitizer, without importing it: the module
+    is only present when ``CHUNKY_BITS_TPU_SANITIZE`` loaded it (the
+    same ``sys.modules`` door parallel/host_pipeline.py uses)."""
+    mod = sys.modules.get("chunky_bits_tpu.analysis.sanitizer")
+    return mod.active() if mod is not None else None
+
+
+def _cancel_all_tasks(loop: asyncio.AbstractEventLoop) -> None:
+    """asyncio.runners' teardown shape: cancel every remaining task and
+    run the loop until they finish, so nothing leaks past the sim run
+    (the SANITIZE=1 contract)."""
+    to_cancel = asyncio.all_tasks(loop)
+    if not to_cancel:
+        return
+    for task in to_cancel:
+        task.cancel()
+    loop.run_until_complete(
+        asyncio.gather(*to_cancel, return_exceptions=True))
+    for task in to_cancel:
+        if task.cancelled():
+            continue
+        if task.exception() is not None:
+            loop.call_exception_handler({
+                "message": "unhandled exception during sim.run() "
+                           "shutdown",
+                "exception": task.exception(),
+                "task": task,
+            })
+
+
+def run(main, *, debug: Optional[bool] = None):
+    """``asyncio.run`` for simulated time: execute ``main`` on a fresh
+    :class:`VirtualTimeLoop` with the clock seam pointing at it.
+
+    Brackets the whole run: installs a ``VirtualClock`` bound to the
+    loop (so every ``cluster/clock.py`` read — EWMA decay, breaker
+    cooldowns, token buckets, hedge delays — ticks in virtual time),
+    restores the previous clock on the way out, and tears the loop down
+    asyncio.run-style.  Everything time-sensitive the coroutine builds
+    (clusters, scoreboards, scrub daemons) must be constructed *inside*
+    it — a TokenBucket built on the real clock would see a huge
+    backwards jump when virtual time starts at 0."""
+    if asyncio.events._get_running_loop() is not None:
+        raise RuntimeError(
+            "sim.run() cannot be called from a running event loop")
+    loop = VirtualTimeLoop()
+    san = _sanitizer()
+    if san is not None:
+        # loops built by the sanitizer's policy are auto-instrumented;
+        # this one is constructed directly, so opt in explicitly
+        san.instrument_loop(loop)
+    previous_clock = clock_mod.install(clock_mod.VirtualClock(loop))
+    try:
+        asyncio.set_event_loop(loop)
+        if debug is not None:
+            loop.set_debug(debug)
+        return loop.run_until_complete(main)
+    finally:
+        # the VirtualClock stays installed through teardown: cancelled
+        # tasks run their cleanup (error paths computing
+        # `monotonic() - start` latency samples) on the still-virtual
+        # loop, and restoring the real clock first would mix timebases
+        # in exactly the way CB108 forbids
+        try:
+            _cancel_all_tasks(loop)
+            loop.run_until_complete(loop.shutdown_asyncgens())
+            loop.run_until_complete(loop.shutdown_default_executor())
+        finally:
+            clock_mod.install(previous_clock)
+            loop._serial_executor.shutdown(wait=True)
+            asyncio.set_event_loop(None)
+            loop.close()

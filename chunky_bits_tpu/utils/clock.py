@@ -1,0 +1,132 @@
+"""The process-wide clock seam (implementation half).
+
+Every time-sensitive policy in the cluster/file planes — EWMA decay and
+breaker cooldowns (cluster/health.py), the scrub token bucket and pass
+interval (cluster/scrub.py), hedge/straggler delays (file/file_part.py),
+retry jitter backoff and health latency samples (file/location.py,
+cluster/destination.py), profiler I/O spans (file/profiler.py) — reads
+time through :func:`monotonic` / :func:`sleep` instead of
+``time.monotonic`` / ``asyncio.sleep`` directly.  In production nothing
+changes: the default :class:`Clock` delegates straight to the system
+primitives at the cost of one extra function call (measured within
+noise on bench configs 2 and 8, BASELINE.md).  The deterministic
+cluster simulator (``chunky_bits_tpu/sim``) swaps in a
+:class:`VirtualClock` bound to its virtual-time event loop, so a
+60-minute scrub pass runs in milliseconds of wall time with every
+latency sample, cooldown, and budget accrual agreeing on the same
+virtual timebase.
+
+**Why this module lives in utils/ and not cluster/:** the canonical
+seam surface IS ``chunky_bits_tpu/cluster/clock.py`` (it re-exports
+everything here, and lint rule CB108 names it as the one sanctioned
+home for direct time reads) — but ``file/`` modules must be importable
+without triggering the ``cluster`` package ``__init__`` (which imports
+``cluster.py`` -> ``destination.py`` -> ``file.location`` and would
+cycle), the same import-cycle hygiene that keeps
+``TRANSIENT_HTTP_STATUSES`` in ``errors.py``.  This module imports
+stdlib only.
+
+**Thread-safety:** :func:`monotonic` is called from event-loop
+callbacks AND host-pipeline worker threads (the health scoreboard
+records completions from both).  The active-clock swap is a single
+attribute rebind (GIL-atomic); ``Clock.monotonic`` and
+``VirtualClock.monotonic`` are both safe from any thread
+(``time.monotonic`` trivially; the virtual loop's ``time()`` reads one
+float).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+__all__ = [
+    "Clock",
+    "VirtualClock",
+    "active",
+    "install",
+    "monotonic",
+    "sleep",
+    "system_clock",
+]
+
+
+class Clock:
+    """The system clock: the zero-surprise default.  ``monotonic`` is
+    ``time.monotonic``; ``sleep`` is ``asyncio.sleep`` on the running
+    loop.  Subclasses (the simulator's :class:`VirtualClock`) override
+    ``monotonic`` to read a virtual timebase."""
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    async def sleep(self, seconds: float) -> None:
+        await asyncio.sleep(seconds)
+
+    def call_later(self, loop: asyncio.AbstractEventLoop,
+                   delay: float, callback, *args) -> asyncio.TimerHandle:
+        """``loop.call_later`` adapter: timer scheduling goes through
+        the loop either way (a virtual loop's timers ARE virtual), so
+        this exists for seam completeness — callers that schedule
+        timers by hand stay on the one clock surface."""
+        return loop.call_later(delay, callback, *args)
+
+
+class VirtualClock(Clock):
+    """A clock slaved to a virtual-time event loop (``sim/loop.py``):
+    ``monotonic()`` returns the loop's virtual ``time()`` from any
+    thread, so durations measured across an await agree exactly with
+    the loop's timer plane.  ``sleep`` stays ``asyncio.sleep`` — on a
+    virtual loop the timer it arms IS virtual, and compression happens
+    in the loop, not here."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop) -> None:
+        self._loop = loop
+
+    def monotonic(self) -> float:
+        # AbstractEventLoop.time() on the sim loop reads one float
+        # (virtual now) — safe cross-thread, never touches loop state
+        return self._loop.time()
+
+
+_SYSTEM = Clock()
+_ACTIVE: Clock = _SYSTEM
+
+
+def system_clock() -> Clock:
+    """The always-real system clock (bench/profiling callers that must
+    measure WALL time even inside a simulation use this explicitly)."""
+    return _SYSTEM
+
+
+def active() -> Clock:
+    """The currently installed clock (the system clock by default)."""
+    return _ACTIVE
+
+
+def install(clock: Optional[Clock]) -> Clock:
+    """Swap the process-wide active clock; returns the previous one so
+    callers can restore it (``install(None)`` restores the system
+    clock).  The simulator brackets every run with
+    ``prev = install(VirtualClock(loop))`` / ``install(prev)`` —
+    production code never calls this."""
+    global _ACTIVE
+    previous = _ACTIVE
+    _ACTIVE = clock if clock is not None else _SYSTEM
+    return previous
+
+
+def monotonic() -> float:
+    """Monotonic seconds on the active clock — THE read every
+    cluster/file-plane duration, cooldown, and budget computation goes
+    through (lint rule CB108 flags direct ``time.monotonic()`` reads
+    in those planes)."""
+    return _ACTIVE.monotonic()
+
+
+async def sleep(seconds: float) -> None:
+    """``asyncio.sleep`` on the active clock.  On the simulator's
+    virtual loop the armed timer is virtual, so a 60 s scrub interval
+    costs microseconds of wall time."""
+    await _ACTIVE.sleep(seconds)

@@ -3,7 +3,16 @@
 The analogue of the reference's warp-over-HashMap fake node
 (tests/location.rs:16-99): GET/HEAD/PUT/DELETE over an in-memory dict, with
 single-range GET support.  Uses an ephemeral port (the reference pins ports
-64000-64005; ephemeral is race-free)."""
+64000-64005; ephemeral is race-free).
+
+Fault injection is NOT implemented here: the node delegates every
+fault decision to a ``chunky_bits_tpu.sim.fabric.FaultInjector`` — the
+same composable models the deterministic cluster simulator drives at
+fleet scale — so the one-shot ``put_fail_status`` / straggler
+``get_delay`` scripts the tests write exercise the exact injection
+logic the scenarios do.  The legacy knob attributes are properties
+forwarding to ``self.faults``.
+"""
 
 from __future__ import annotations
 
@@ -11,23 +20,53 @@ import asyncio
 
 from aiohttp import web
 
+from chunky_bits_tpu.sim.fabric import FaultInjector
+
 
 class FakeHttpNode:
     def __init__(self, fail_puts: bool = False) -> None:
         self.store: dict[str, bytes] = {}
         self._runner = None
         self.port: int = 0
-        #: node-wide broken-disk mode: every PUT returns 507
-        self.fail_puts = fail_puts
+        #: the fault model (sim/fabric.py): node-wide broken-disk mode,
+        #: straggler stalls, one-shot PUT statuses
+        self.faults = FaultInjector(fail_puts=fail_puts)
         self.put_attempts = 0
         self.get_attempts = 0
-        #: node-wide straggler mode: every GET stalls this long before
-        #: answering (stall, not fail — the hedged-read scenario)
-        self.get_delay = 0.0
-        #: one-shot status override: next N PUTs answer with this
-        #: status (transient-retry tests), then normal service resumes
-        self.put_fail_status = 0
-        self.put_fail_remaining = 0
+
+    # ---- legacy knob surface (forwards to the shared fault model) ----
+
+    @property
+    def fail_puts(self) -> bool:
+        return self.faults.fail_puts
+
+    @fail_puts.setter
+    def fail_puts(self, value: bool) -> None:
+        self.faults.fail_puts = value
+
+    @property
+    def get_delay(self) -> float:
+        return self.faults.get_delay
+
+    @get_delay.setter
+    def get_delay(self, value: float) -> None:
+        self.faults.get_delay = value
+
+    @property
+    def put_fail_status(self) -> int:
+        return self.faults.put_fail_status
+
+    @put_fail_status.setter
+    def put_fail_status(self, value: int) -> None:
+        self.faults.put_fail_status = value
+
+    @property
+    def put_fail_remaining(self) -> int:
+        return self.faults.put_fail_remaining
+
+    @put_fail_remaining.setter
+    def put_fail_remaining(self, value: int) -> None:
+        self.faults.put_fail_remaining = value
 
     @property
     def url(self) -> str:
@@ -36,8 +75,9 @@ class FakeHttpNode:
     async def _get(self, request: web.Request) -> web.Response:
         key = request.match_info["key"]
         self.get_attempts += 1
-        if self.get_delay > 0:
-            await asyncio.sleep(self.get_delay)
+        delay = self.faults.get_fault()
+        if delay > 0:
+            await asyncio.sleep(delay)
         if key.startswith("redir/"):
             raise web.HTTPFound(location=f"/{key[len('redir/'):]}")
         data = self.store.get(key)
@@ -65,11 +105,12 @@ class FakeHttpNode:
     async def _put(self, request: web.Request) -> web.Response:
         key = request.match_info["key"]
         self.put_attempts += 1
-        if self.put_fail_remaining > 0:
-            self.put_fail_remaining -= 1
-            return web.Response(status=self.put_fail_status)
-        if self.fail_puts or key.startswith("fail/"):
-            # simulated full/broken disk
+        status = self.faults.put_fault()
+        if status:
+            return web.Response(status=status)
+        if key.startswith("fail/"):
+            # path-scripted broken disk (kept for tests addressing a
+            # subtree, not a node-wide state)
             return web.Response(status=507)
         self.store[key] = await request.read()
         return web.Response()

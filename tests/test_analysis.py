@@ -328,6 +328,94 @@ def test_private_and_nonstrict_modules_pass(tmp_path):
     """, select=("CB106",)) == []
 
 
+# ---- CB108 clock-seam ----
+
+def test_clock_rule_flags_direct_monotonic_in_scope(tmp_path):
+    vs = run_snippet(tmp_path, "cluster/x.py", """
+        import time
+
+        def f():
+            return time.monotonic()
+    """, select=("CB108",))
+    assert [v.rule for v in vs] == ["CB108"]
+    assert "clock seam" in vs[0].message
+
+
+def test_clock_rule_flags_time_time_and_loop_time(tmp_path):
+    vs = run_snippet(tmp_path, "file/x.py", """
+        import asyncio
+        import time
+
+        def stamp():
+            return time.time()
+
+        async def deadline():
+            loop = asyncio.get_running_loop()
+            return loop.time() + 30.0
+    """, select=("CB108",))
+    assert [v.rule for v in vs] == ["CB108", "CB108"]
+
+
+def test_clock_rule_out_of_scope_and_seam_module_pass(tmp_path):
+    # the seam module itself is the one sanctioned home for direct
+    # reads; ops/ outside batching.py and other planes are out of scope
+    assert run_snippet(tmp_path, "cluster/clock.py", """
+        import time
+
+        def monotonic():
+            return time.monotonic()
+    """, select=("CB108",)) == []
+    assert run_snippet(tmp_path, "ops/backend.py", """
+        import time
+
+        def f():
+            return time.monotonic()
+    """, select=("CB108",)) == []
+
+
+def test_clock_rule_flags_alias_import_spellings(tmp_path):
+    # the CB102 convention: renamed imports must not slip past the lint
+    vs = run_snippet(tmp_path, "cluster/x.py", """
+        import time as t
+        from time import monotonic
+        from time import perf_counter as pc
+
+        def f():
+            return t.monotonic() + monotonic() + pc()
+    """, select=("CB108",))
+    assert [v.rule for v in vs] == ["CB108", "CB108", "CB108"]
+
+
+def test_clock_rule_passes_non_loop_time_methods(tmp_path):
+    # a .time() on an arbitrary call result is NOT loop.time(): only
+    # event-loop getters count as the call-result spelling
+    assert run_snippet(tmp_path, "cluster/x.py", """
+        import datetime
+
+        def f():
+            return datetime.datetime.now().time()
+    """, select=("CB108",)) == []
+
+
+def test_clock_rule_suppression_with_reason(tmp_path):
+    assert run_snippet(tmp_path, "file/x.py", """
+        import time
+
+        def publish_stamp():
+            # lint: clock-ok wall-clock stamp for humans
+            return time.time()
+    """, select=("CB108",)) == []
+
+
+def test_clock_rule_passes_seam_reads(tmp_path):
+    assert run_snippet(tmp_path, "cluster/x.py", """
+        from chunky_bits_tpu.cluster import clock as _clock
+
+        def f():
+            return _clock.monotonic()
+    """, select=("CB108",)) == []
+
+
 # ---- CB201 async-blocking ----
 
 def test_async_blocking_flags_sleep_open_subprocess(tmp_path):

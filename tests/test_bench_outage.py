@@ -288,6 +288,54 @@ def test_config13_failure_emits_one_json_line():
     assert "error" in rec
 
 
+def test_config14_smoke_emits_one_json_line():
+    """--config 14 --smoke (simulator scenario suite at CI scale: 12
+    nodes, 3 scenarios) honors the driver contract: exactly one
+    parseable JSON line on stdout with the required keys plus the
+    per-scenario rows, exit 0 — and the run itself asserts every
+    scenario's invariant verdicts AND the same-seed determinism
+    double-run (byte-identical trace, equal metrics)."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "14", "--smoke"],
+        cwd=REPO, env=env, capture_output=True, timeout=300)
+    assert r.returncode == 0, r.stderr.decode()[-800:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "nodes",
+                "scenarios", "scenarios_ok", "virtual_s", "wall_s",
+                "deterministic", "rows"):
+        assert key in rec
+    assert rec["unit"] == "x"
+    # compressed virtual time is the metric: even at smoke scale the
+    # suite must live orders of magnitude more virtual life than wall
+    assert rec["value"] > 10
+    assert rec["deterministic"] is True
+    assert rec["scenarios_ok"] == rec["scenarios"] == len(rec["rows"])
+    for row in rec["rows"]:
+        assert row["ok"] is True, row
+
+
+def test_config14_failure_emits_one_json_line():
+    """ANY --config 14 failure (here: an unknown scenario name) still
+    produces exactly one parseable JSON line and exit 3 — the same
+    contract as configs 8-13 and the device runs."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "14",
+         "--scenarios", "heat_death"],
+        cwd=REPO, env=env, capture_output=True, timeout=120)
+    assert r.returncode == 3, r.stderr.decode()[-500:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec
+    assert rec["value"] == 0.0
+    assert "error" in rec
+
+
 def test_seams_only_shrink_and_tolerate_garbage():
     """Inherited env values must not break the contract: malformed or
     larger-than-default values fall back to the real budget."""

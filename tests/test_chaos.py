@@ -9,6 +9,14 @@ step:
 * with at most p chunks damaged per part, reads stay byte-identical;
 * resilver always returns an object to Valid and its content survives;
 * listing reflects every object ever written.
+
+The soaks run on the simulator's virtual-time loop (``sim.run``):
+retry backoff, scrub intervals and convergence polling compress to
+milliseconds of wall time, so they stay un-``slow``-marked in tier-1.
+One real-clock soak remains as the ``slow``-marked canary
+(``test_chaos_slow_location_hedged``) — it deliberately pays wall-clock
+stalls so a regression in the REAL timer path can't hide behind the
+virtual conversions.
 """
 
 import asyncio
@@ -20,6 +28,7 @@ import pytest
 
 from chunky_bits_tpu.cluster import Cluster
 from chunky_bits_tpu.file import FileIntegrity
+from chunky_bits_tpu.sim import run as sim_run
 from chunky_bits_tpu.utils import aio
 
 
@@ -34,12 +43,11 @@ def test_chaos_soak(tmp_path, seed):
         dirs.append(str(d))
     meta = root / "meta"
     meta.mkdir()
-    cluster = Cluster.from_obj({
-        "destinations": [{"location": x} for x in dirs],
-        "metadata": {"type": "path", "format": "yaml", "path": str(meta)},
-        "profiles": {"default": {"data": 3, "parity": 2,
-                                 "chunk_size": 12}},
-    })
+
+    # built inside main(): every time-sensitive object (scoreboard,
+    # retry backoff) must be born under the virtual clock sim.run
+    # installs, not capture real timestamps before it
+    cluster: Cluster = None  # type: ignore[assignment]
 
     contents: dict[str, bytes] = {}
     # chunks we have damaged since the last resilver, per object:
@@ -108,6 +116,14 @@ def test_chaos_soak(tmp_path, seed):
         await op_read(name)
 
     async def main():
+        nonlocal cluster
+        cluster = Cluster.from_obj({
+            "destinations": [{"location": x} for x in dirs],
+            "metadata": {"type": "path", "format": "yaml",
+                         "path": str(meta)},
+            "profiles": {"default": {"data": 3, "parity": 2,
+                                     "chunk_size": 12}},
+        })
         await op_write("obj0")
         for step in range(40):
             names = list(contents)
@@ -140,17 +156,23 @@ def test_chaos_soak(tmp_path, seed):
             assert any(name in x for x in listed_names), \
                 f"{name} missing from listing {listed_names}"
 
-    asyncio.run(main())
+    sim_run(main())
 
 
+@pytest.mark.slow
 def test_chaos_slow_location_hedged(tmp_path):
-    """Straggler chaos (stall, not fail): every chunk has two replicas
-    and one node serves with a 500 ms stall.  A hedged read
-    (`tunables.hedge_ms`) must complete near the FAST replica's
-    latency — far under one stall — and bytes must be identical
-    whichever location wins the race: slow-node-primary (replica wins),
-    fast-primary (primary wins), and hedging-off (the stall is simply
-    paid) must all agree."""
+    """THE real-clock canary (slow-marked, excluded from tier-1):
+    straggler chaos over real sockets with real stalls, asserting
+    wall-clock hedge latency — the one soak that would catch a
+    regression in the REAL timer path that the virtual-time
+    conversions cannot see.
+
+    Every chunk has two replicas and one node serves with a 500 ms
+    stall.  A hedged read (`tunables.hedge_ms`) must complete near the
+    FAST replica's latency — far under one stall — and bytes must be
+    identical whichever location wins the race: slow-node-primary
+    (replica wins), fast-primary (primary wins), and hedging-off (the
+    stall is simply paid) must all agree."""
     import time
 
     from chunky_bits_tpu.file.location import Location
@@ -239,6 +261,96 @@ def test_chaos_slow_location_hedged(tmp_path):
                 await n.stop()
 
     asyncio.run(main())
+
+
+def test_chaos_slow_location_hedged_virtual(tmp_path):
+    """The straggler scenario in compressed virtual time (the tier-1
+    face of the slow canary above): simulated nodes, one slowed by the
+    fabric's fault state machine, durations measured on the virtual
+    clock.  Hedging-off pays the straggler's latency; hedging-on
+    completes near the fast replica's latency; bytes are identical
+    either way."""
+    from chunky_bits_tpu.file.location import Location
+    from chunky_bits_tpu.sim import fabric as fabric_mod
+    from chunky_bits_tpu.utils import clock as clock_mod
+
+    rng = np.random.default_rng(11)
+    meta = tmp_path / "meta"
+    meta.mkdir()
+    payload = rng.integers(0, 256, 150000, dtype=np.uint8).tobytes()
+
+    async def main():
+        fab = fabric_mod.SimFabric("hedge", 5, zones=("z",), seed=11)
+        try:
+            def make_cluster(hedge_ms):
+                return Cluster.from_obj({
+                    "destinations": fab.destination_objs(),
+                    "metadata": {"type": "path", "format": "yaml",
+                                 "path": str(meta)},
+                    "profiles": {"default": {"data": 3, "parity": 2,
+                                             "chunk_size": 14}},
+                    "tunables": {"hedge_ms": hedge_ms},
+                })
+
+            writer = make_cluster(0)
+            await writer.write_file("obj", aio.BytesReader(payload),
+                                    writer.get_profile())
+            ref = await writer.get_file_ref("obj")
+            # replicate every chunk onto a second node, never n0000:
+            # n0000 is the one slow replica of the scenario
+            nodes = [fab.nodes[k] for k in sorted(fab.nodes)]
+            pick = 1
+            for part in ref.parts:
+                for chunk in part.data + part.parity:
+                    owner, key = fabric_mod.resolve(
+                        chunk.locations[0].target)
+                    while nodes[pick] is owner or pick == 0:
+                        pick = (pick + 1) % len(nodes)
+                    replica = nodes[pick]
+                    replica.store[key] = owner.store[key]
+                    chunk.locations.append(Location.sim(
+                        f"{fab.fabric_id}/{replica.node_id}/{key}"))
+                    pick = (pick + 1) % len(nodes)
+            await writer.write_file_ref("obj", ref)
+
+            async def read_all(cluster):
+                r = await cluster.get_file_ref("obj")
+                return await cluster.file_read_builder(r).read_all()
+
+            # n0000 straggles: ~0.5 s of VIRTUAL latency per request
+            # (median 2 ms x 250), the state machine's slow mode
+            slow = fab.nodes["n0000"]
+            slow.slow_factor = 250.0
+            slow.set_state(fabric_mod.SLOW)
+
+            # hedging OFF pays the straggler but stays byte-identical
+            cold = make_cluster(0)
+            t0 = clock_mod.monotonic()
+            assert await read_all(cold) == payload
+            off_elapsed = clock_mod.monotonic() - t0
+            assert off_elapsed >= 0.2, (
+                f"unhedged read took {off_elapsed:.3f}s virtual — "
+                "never met the straggler?")
+
+            # hedging ON completes near the fast replica's latency
+            hedged = make_cluster(25)
+            t0 = clock_mod.monotonic()
+            assert await read_all(hedged) == payload
+            on_elapsed = clock_mod.monotonic() - t0
+            assert on_elapsed < 0.2, (
+                f"hedged read took {on_elapsed:.3f}s virtual — it "
+                "waited out the straggler instead of racing the "
+                "fast replica")
+            assert await read_all(hedged) == payload
+            stats = hedged.health_scoreboard().stats()
+            assert stats.hedges_fired >= 1, \
+                f"no hedges fired against a straggler: {stats}"
+            for cluster in (cold, hedged, writer):
+                await cluster.tunables.location_context().aclose()
+        finally:
+            fab.close()
+
+    sim_run(main())
 
 
 def test_chaos_slab_store_churn(tmp_path):
@@ -354,7 +466,9 @@ def test_chaos_scrub_daemon_under_concurrent_churn(tmp_path):
     resilver.  Afterwards every object reads byte-identical, a final
     scrub pass leaves everything Valid, and the daemon stops cleanly —
     under SANITIZE=1 the conftest additionally fails the session if
-    any scrub task leaked."""
+    any scrub task leaked.  Runs in virtual time: the daemon's
+    interval sleeps and the convergence poll compress to nothing, so
+    the soak can afford generous virtual deadlines."""
     from chunky_bits_tpu.cluster.scrub import ScrubDaemon
 
     rng = np.random.default_rng(17)
@@ -366,12 +480,7 @@ def test_chaos_scrub_daemon_under_concurrent_churn(tmp_path):
         dirs.append(str(d))
     meta = root / "meta"
     meta.mkdir()
-    cluster = Cluster.from_obj({
-        "destinations": [{"location": f"slab:{x}"} for x in dirs],
-        "metadata": {"type": "path", "format": "yaml", "path": str(meta)},
-        "profiles": {"default": {"data": 3, "parity": 2,
-                                 "chunk_size": 12}},
-    })
+    cluster: Cluster = None  # type: ignore[assignment]
     contents: dict[str, bytes] = {}
 
     async def write(name):
@@ -400,6 +509,14 @@ def test_chaos_scrub_daemon_under_concurrent_churn(tmp_path):
             f.write(bytes([byte[0] ^ 0x10]))
 
     async def main():
+        nonlocal cluster
+        cluster = Cluster.from_obj({
+            "destinations": [{"location": f"slab:{x}"} for x in dirs],
+            "metadata": {"type": "path", "format": "yaml",
+                         "path": str(meta)},
+            "profiles": {"default": {"data": 3, "parity": 2,
+                                     "chunk_size": 12}},
+        })
         daemon = ScrubDaemon(cluster, bytes_per_sec=50_000_000,
                              interval_seconds=0.01)
         daemon.start()
@@ -458,7 +575,7 @@ def test_chaos_scrub_daemon_under_concurrent_churn(tmp_path):
                 await cluster.get_file_ref(name)).read_all()
             assert got == payload, f"post-churn mismatch for {name}"
 
-    asyncio.run(main())
+    sim_run(main())
 
 
 def test_chaos_disk_full_on_one_slab_destination(tmp_path, monkeypatch):
