@@ -472,19 +472,21 @@ class ClockSeamRule(Rule):
     deterministic cluster simulator (``chunky_bits_tpu/sim``) can swap
     in a virtual clock and compress hours of scenario into seconds.  A
     direct ``time.monotonic()`` / ``time.time()`` / ``loop.time()``
-    read in ``cluster/``, ``file/`` or ``ops/batching.py`` would tick
-    in REAL time inside a virtual-time run — every duration touching
-    it silently corrupts.  Justified wall-clock sites (human-facing
-    timestamps like slab publish stamps) carry
-    ``# lint: clock-ok <reason>``; the seam module itself is the one
-    sanctioned home for direct reads.
+    read in ``cluster/``, ``file/``, ``ops/batching.py`` or
+    ``obs/slo.py`` (the SLO engine's window arithmetic MUST compress
+    with the scenario it observes, or detection latency would be
+    measured on the wrong timebase) would tick in REAL time inside a
+    virtual-time run — every duration touching it silently corrupts.
+    Justified wall-clock sites (human-facing timestamps like slab
+    publish stamps) carry ``# lint: clock-ok <reason>``; the seam
+    module itself is the one sanctioned home for direct reads.
     """
 
     id = "CB108"
     slug = "clock"
     description = ("cluster/file-plane time reads go through the "
                    "cluster/clock.py seam")
-    paths = ("cluster/", "file/", "ops/batching.py")
+    paths = ("cluster/", "file/", "ops/batching.py", "obs/slo.py")
 
     #: the clock-read function names (incl. the nanosecond spellings —
     #: a ns read mixes timebases just as silently); alias-import

@@ -154,10 +154,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser(
         "stats",
-        help="Fetch a running gateway's /stats, /healthz, /scrub/status"
-             " and /metrics and render a one-screen summary")
+        help="Fetch a running gateway's /stats, /healthz, /scrub/status,"
+             " /alerts and /metrics and render a one-screen summary")
     p.add_argument("--json", action="store_true",
                    help="emit the combined raw JSON payloads instead")
+    p.add_argument("--watch", type=float, default=0.0, metavar="N",
+                   help="redraw every N seconds until ctrl-c (a live "
+                        "operator console; 0 = one shot, the default)")
     p.add_argument("url", help="gateway base URL (host:port or http://…)")
 
     p = sub.add_parser("verify", help="Verify a cluster file")
@@ -353,7 +356,8 @@ async def _run_command(args, config) -> int:
     elif cmd == "stats":
         from chunky_bits_tpu.cli.stats import stats_command
 
-        return await stats_command(args.url, args.json)
+        return await stats_command(args.url, args.json,
+                                   watch_s=max(args.watch, 0.0))
     elif cmd == "verify":
         target = ClusterLocation.parse(args.target)
         report = await target.verify(config)

@@ -1,14 +1,19 @@
-"""``chunky-bits stats [--json] <gateway-url>`` — one-screen gateway
-observability summary.
+"""``chunky-bits stats [--json] [--watch N] <gateway-url>`` —
+one-screen gateway observability summary.
 
 Fetches the observability surface of a running gateway (``/stats``,
-``/healthz``, ``/scrub/status`` and — as a grammar check — ``/metrics``)
-and renders it for a human: request percentiles (computed server-side
-by the same ``request_stats``/``percentile`` code in file/profiler.py
-that bench --config 9 uses), cache hit rates, pipeline saturation,
-per-node health, scrub progress, and the event-loop lag histogram's
-tail (``obs.metrics.histogram_quantile`` over the scraped buckets).
-``--json`` emits the combined raw payloads for machine consumers.
+``/healthz``, ``/scrub/status``, ``/alerts`` and — as a grammar check —
+``/metrics``) and renders it for a human: request percentiles (computed
+server-side by the same ``request_stats``/``percentile`` code in
+file/profiler.py that bench --config 9 uses), cache hit rates, pipeline
+saturation, per-node health, scrub progress, SLO alert states
+(obs/slo.py — firing rules first, with their windowed values against
+their objectives), and the event-loop lag histogram's tail
+(``obs.metrics.histogram_quantile`` over the scraped buckets).
+``--json`` emits the combined raw payloads for machine consumers;
+``--watch N`` redraws every N seconds (clock-seam timed, so the one
+tool works under a virtual clock too) — a live operator console
+without an external scraper.
 
 No reference counterpart (the reference has no metrics surface); a
 TPU-repo extension documented in PARITY.md.
@@ -23,12 +28,14 @@ from typing import Optional, TextIO
 from chunky_bits_tpu.errors import ChunkyBitsError
 from chunky_bits_tpu.obs import metrics as obs_metrics
 
+#: the clock seam (canonical surface cluster/clock.py; utils-side
+#: import for cycle hygiene) — the --watch redraw cadence follows the
+#: active clock like every other timed policy
+from chunky_bits_tpu.utils import clock as _clock
 
-def _family(snapshot: dict, name: str) -> Optional[dict]:
-    for fam in snapshot.get("families", ()):
-        if fam.get("name") == name:
-            return fam
-    return None
+
+#: family-by-name lookup — the shared scan in obs/metrics.py
+_family = obs_metrics.find_family
 
 
 def _scalar_total(snapshot: dict, name: str) -> float:
@@ -39,7 +46,8 @@ def _scalar_total(snapshot: dict, name: str) -> float:
 
 
 def render_summary(stats: dict, healthz: dict, scrub: dict,
-                   out: TextIO) -> None:
+                   out: TextIO,
+                   alerts: Optional[dict] = None) -> None:
     """The one-screen human rendering (pure function of the fetched
     payloads so tests can pin it without a socket)."""
     snap = stats.get("metrics", {"families": []})
@@ -130,20 +138,42 @@ def render_summary(stats: dict, healthz: dict, scrub: dict,
                           file=out)
     else:
         print("scrub: disabled", file=out)
+    alerts = alerts if alerts is not None else {"enabled": False}
+    if not alerts.get("enabled"):
+        print("slo: disabled", file=out)
+    else:
+        firing = alerts.get("firing", [])
+        fleet = alerts.get("fleet") or {}
+        fleet_firing = fleet.get("firing", [])
+        header = (f"slo: {len(firing)} firing "
+                  f"(evals={alerts.get('evaluations', 0)})")
+        if fleet:
+            header += f" fleet-firing={len(fleet_firing)}"
+        print(header, file=out)
+        # firing rules first (the operator's first question), then
+        # pending; quiet rules stay off the screen
+        rows = sorted(alerts.get("alerts", ()),
+                      key=lambda a: (a.get("state") != "firing",
+                                     a.get("state") != "pending",
+                                     a.get("rule", "")))
+        for a in rows:
+            if a.get("state") == "inactive":
+                continue
+            fast = a.get("value_fast")
+            fast_s = "-" if fast is None else f"{fast:.4g}"
+            print(f"  alert {a.get('rule')}: {a.get('state')} "
+                  f"value={fast_s} threshold={a.get('threshold')} "
+                  f"fired_count={a.get('fired_count', 0)}", file=out)
 
 
-async def stats_command(url: str, as_json: bool,
-                        out: Optional[TextIO] = None) -> int:
-    """Fetch + render; the ``chunky-bits stats`` body.  Raises
-    ChunkyBitsError on an unreachable/defective gateway (including a
-    /metrics payload that fails the exposition grammar — a stats tool
-    must not silently summarize garbage)."""
+async def fetch_once(base: str) -> tuple[dict, dict, dict, dict]:
+    """One round of the gateway's observability surface:
+    (stats, healthz, scrub, alerts) — with the /metrics exposition
+    grammar gate riding along (the same parser the tests and CI scrape
+    step use).  Raises ChunkyBitsError on an unreachable or defective
+    gateway — a stats tool must not silently summarize garbage."""
     import aiohttp
 
-    out = out if out is not None else sys.stdout
-    base = url.rstrip("/")
-    if "://" not in base:
-        base = f"http://{base}"
     try:
         async with aiohttp.ClientSession() as session:
             async with session.get(f"{base}/stats") as resp:
@@ -155,13 +185,21 @@ async def stats_command(url: str, as_json: bool,
                 healthz = await resp.json()
             async with session.get(f"{base}/scrub/status") as resp:
                 scrub = await resp.json()
+            async with session.get(f"{base}/alerts") as resp:
+                if resp.status == 200:
+                    alerts = await resp.json()
+                else:
+                    # a pre-SLO gateway 404s here (the catch-all
+                    # treats "alerts" as an object name): render the
+                    # rest of the stats surface with the slo stanza
+                    # disabled instead of failing the whole command —
+                    # mixed-version fleets are a normal rollout state
+                    alerts = {"enabled": False}
             async with session.get(f"{base}/metrics") as resp:
                 metrics_text = await resp.text()
     except aiohttp.ClientError as err:
         raise ChunkyBitsError(f"cannot reach gateway {base}: {err}") \
             from err
-    # the exposition grammar gate rides every stats call — the same
-    # parser the tests and CI scrape step use
     try:
         obs_metrics.parse_exposition(metrics_text)
     except obs_metrics.ExpositionError as err:
@@ -170,10 +208,37 @@ async def stats_command(url: str, as_json: bool,
         # report, not a crash
         raise ChunkyBitsError(
             f"{base}/metrics is not valid exposition: {err}") from err
-    if as_json:
-        json.dump({"stats": stats, "healthz": healthz, "scrub": scrub},
-                  out, indent=2)
-        print(file=out)
-    else:
-        render_summary(stats, healthz, scrub, out)
-    return 0
+    return stats, healthz, scrub, alerts
+
+
+async def stats_command(url: str, as_json: bool,
+                        out: Optional[TextIO] = None,
+                        watch_s: float = 0.0) -> int:
+    """Fetch + render; the ``chunky-bits stats`` body.  ``watch_s`` > 0
+    loops forever, redrawing every that-many seconds (timed through the
+    clock seam) with a timestamped separator between frames — the live
+    operator console for the alert/SLO stanza.  Ctrl-C exits the loop
+    cleanly (the CLI's standard 130)."""
+    out = out if out is not None else sys.stdout
+    base = url.rstrip("/")
+    if "://" not in base:
+        base = f"http://{base}"
+    frame = 0
+    while True:
+        stats, healthz, scrub, alerts = await fetch_once(base)
+        if as_json:
+            json.dump({"stats": stats, "healthz": healthz,
+                       "scrub": scrub, "alerts": alerts},
+                      out, indent=2)
+            print(file=out)
+        else:
+            if watch_s > 0:
+                print(f"--- frame {frame} "
+                      f"(every {watch_s:g}s, ctrl-c to stop) ---",
+                      file=out)
+            render_summary(stats, healthz, scrub, out, alerts=alerts)
+        if watch_s <= 0:
+            return 0
+        frame += 1
+        out.flush()
+        await _clock.sleep(watch_s)

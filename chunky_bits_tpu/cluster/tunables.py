@@ -145,6 +145,19 @@ XOR_SCHEDULE_ENV = "CHUNKY_BITS_TPU_XOR_SCHEDULE"
 #: read-at-first-dispatch contract, set it before the first write.
 CODE_ENV = "CHUNKY_BITS_TPU_CODE"
 
+#: SLO engine evaluation cadence in seconds (obs/slo.py +
+#: gateway/http.py): > 0 runs the windowed burn-rate alert engine —
+#: a bounded ring of registry snapshots evaluated against the closed
+#: rule set every this-many seconds, surfaced at ``GET /alerts``, in
+#: ``/stats``, and as ``cb_slo_*``/``cb_alerts_*`` metric families.
+#: 0/unset = engine off entirely (the default — no ring, no ticker,
+#: zero overhead, per the measure-before-defaulting invariant; bench
+#: --config 15 is the overhead A/B).  Objective thresholds come from
+#: the YAML ``slo:`` mapping (SloObjectives.from_obj — loud on unknown
+#: keys).  YAML ``slo_eval_s`` wins; the env var supplies the default.
+#: Read at gateway app build.
+SLO_EVAL_S_ENV = "CHUNKY_BITS_TPU_SLO_EVAL_S"
+
 #: opt-in runtime concurrency sanitizer (analysis/sanitizer.py):
 #: event-loop stall watchdog, task-leak registry, host-pipeline handoff
 #: checks.  Off by default (and force-disabled by bench.py — the
@@ -343,6 +356,18 @@ def trace_slow_ms(*, default: float = 0.0) -> float:
     return v if v > 0 else default
 
 
+def slo_eval_s(*, default: float = 0.0) -> float:
+    """Env-supplied default for the ``slo_eval_s`` tunable (YAML wins;
+    0 = the SLO engine stays off).  Lenient like ``hedge_ms`` —
+    malformed or negative values read as off."""
+    raw = os.environ.get(SLO_EVAL_S_ENV, "")
+    try:
+        v = float(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
 def read_retries(*, default: int = 1) -> int:
     """Env-supplied default for the ``read_retries`` tunable (YAML
     wins): per-location transient-HTTP retry count on the read
@@ -376,6 +401,12 @@ def _default_trace_slow_ms() -> float:
     """Env-supplied default for the ``trace_slow_ms`` tunable (YAML
     wins; 0 = request tracing off)."""
     return trace_slow_ms(default=0.0)
+
+
+def _default_slo_eval_s() -> float:
+    """Env-supplied default for the ``slo_eval_s`` tunable (YAML wins;
+    0 = SLO engine off)."""
+    return slo_eval_s(default=0.0)
 
 
 def _default_repair_block_bytes() -> int:
@@ -439,6 +470,14 @@ class Tunables:
     #: supplies the default.
     repair_block_bytes: int = field(
         default_factory=_default_repair_block_bytes)
+    #: SLO engine evaluation cadence in seconds (obs/slo.py); 0 keeps
+    #: the engine off (the default — zero overhead when off).  YAML
+    #: wins; ``CHUNKY_BITS_TPU_SLO_EVAL_S`` supplies the default.
+    slo_eval_s: float = field(default_factory=_default_slo_eval_s)
+    #: SLO objective overrides (the YAML ``slo:`` mapping, validated
+    #: loudly against obs/slo.py SloObjectives' field set); empty =
+    #: the conservative defaults
+    slo: dict = field(default_factory=dict)
 
     def is_device_backend(self) -> bool:
         """True when the erasure plane runs on an accelerator ("jax" or a
@@ -533,6 +572,28 @@ class Tunables:
             if repair_v < 0:
                 raise SerdeError(
                     f"repair_block_bytes must be >= 0, got {repair_v}")
+        slo_eval_v = obj.get("slo_eval_s", None)
+        if slo_eval_v is not None:
+            try:
+                slo_eval_v = float(slo_eval_v)
+            except (TypeError, ValueError) as err:
+                raise SerdeError(
+                    f"invalid slo_eval_s {slo_eval_v!r}") from err
+            if slo_eval_v < 0:
+                raise SerdeError(
+                    f"slo_eval_s must be >= 0, got {slo_eval_v}")
+        slo_v = obj.get("slo", None)
+        if slo_v is not None:
+            # validate LOUDLY at config-load time (a typo'd objective
+            # must fail the cluster parse, not silently never alert);
+            # obs/slo.py owns the field set
+            from chunky_bits_tpu.obs.slo import SloObjectives
+
+            try:
+                SloObjectives.from_obj(slo_v)
+            except ValueError as err:
+                raise SerdeError(f"invalid slo mapping: {err}") from err
+            slo_v = dict(slo_v)
         return cls(
             https_only=bool(obj.get("https_only", False)),
             on_conflict=on_conflict,
@@ -552,6 +613,9 @@ class Tunables:
                if trace_v is not None else {}),
             **({"repair_block_bytes": repair_v}
                if repair_v is not None else {}),
+            **({"slo_eval_s": slo_eval_v}
+               if slo_eval_v is not None else {}),
+            **({"slo": slo_v} if slo_v is not None else {}),
         )
 
     def to_obj(self) -> dict:
@@ -576,6 +640,10 @@ class Tunables:
             obj["trace_slow_ms"] = self.trace_slow_ms
         if self.repair_block_bytes > 0:
             obj["repair_block_bytes"] = self.repair_block_bytes
+        if self.slo_eval_s > 0:
+            obj["slo_eval_s"] = self.slo_eval_s
+        if self.slo:
+            obj["slo"] = dict(self.slo)
         return obj
 
     def location_context(self) -> LocationContext:

@@ -164,6 +164,7 @@ class Profiler:
         self._pipelines: list = []  # host pipelines ditto
         self._healths: list = []  # location-health scoreboards ditto
         self._scrubs: list = []  # scrub daemons ditto
+        self._slos: list = []  # SLO engines (obs/slo.py) ditto
         # per-location failure notes from the read fall-through
         # (fetch_chunk): which location failed / was corrupt and why —
         # the diagnosable trail the anonymous `except LocationError:
@@ -252,6 +253,20 @@ class Profiler:
         with self._lock:
             return [s.stats() for s in self._scrubs]
 
+    def attach_slo(self, engine) -> None:
+        """Register an SLO engine (obs/slo.py) so firing/pending alert
+        counts ride along in the report's ``Slo<...>`` stanza — the
+        report and ``GET /alerts`` must tell one story (the PR-8
+        one-set-of-numbers discipline)."""
+        with self._lock:
+            if all(e is not engine for e in self._slos):
+                self._slos.append(engine)
+
+    def slo_stats(self) -> list:
+        """Snapshot of each attached SLO engine (SloStats)."""
+        with self._lock:
+            return [e.stats() for e in self._slos]
+
     def log_location_failure(self, location, error: str) -> None:
         """A per-location read failure (unreadable or hash-mismatched)
         recorded by the chunk fall-through — the read completed via
@@ -338,7 +353,7 @@ class ProfileReport:
     def __init__(self, entries: list[ResultLog], cache_stats: list = (),
                  pipeline_stats: list = (), health_stats: list = (),
                  location_failures: list = (), requests: list = (),
-                 scrub_stats: list = (),
+                 scrub_stats: list = (), slo_stats: list = (),
                  dropped: Optional[dict] = None):
         self.entries = entries
         self.cache_stats = list(cache_stats)
@@ -347,6 +362,7 @@ class ProfileReport:
         self.location_failures = list(location_failures)
         self.requests = list(requests)
         self.scrub_stats = list(scrub_stats)
+        self.slo_stats = list(slo_stats)
         self.dropped = dict(dropped or {})
 
     def _avg(self, kind: str) -> Optional[float]:
@@ -386,6 +402,8 @@ class ProfileReport:
             base += f" {stats}"
         for stats in self.scrub_stats:
             base += f" {stats}"
+        for stats in self.slo_stats:
+            base += f" {stats}"
         if self.requests:
             base += f" {request_stats(self.requests)}"
         if self.location_failures:
@@ -416,6 +434,7 @@ class ProfileReporter:
                              self._profiler.drain_location_failures(),
                              self._profiler.drain_requests(),
                              self._profiler.scrub_stats(),
+                             self._profiler.slo_stats(),
                              self._profiler.drop_counts())
 
 

@@ -354,6 +354,21 @@ def make_app(cluster: Cluster,
 
         sendfile = gateway_sendfile()
 
+    # SLO engine (obs/slo.py): windowed burn-rate alerting over this
+    # registry's snapshots, default OFF — constructed only when the
+    # cluster's `slo_eval_s` tunable asks for it, so the idle cost is
+    # literally zero (no ring, no ticker task, /alerts answers
+    # enabled:false).  Objectives come from the YAML `slo:` mapping.
+    slo_eval = max(cluster.tunables.slo_eval_s, 0.0)
+    slo_engine = None
+    if slo_eval > 0:
+        from chunky_bits_tpu.obs import slo as obs_slo
+
+        slo_engine = obs_slo.SloEngine(
+            objectives=obs_slo.SloObjectives.from_obj(
+                cluster.tunables.slo or None),
+            registry=registry)
+
     # the app's own profiler collects the per-request access log; the
     # cluster's serve-path counters (cache, health) ride along so one
     # report shows the whole serving picture
@@ -362,6 +377,26 @@ def make_app(cluster: Cluster,
     profiler.attach_health(cluster.health_scoreboard())
     if scrub is not None:
         profiler.attach_scrub(scrub)
+    if slo_engine is not None:
+        profiler.attach_slo(slo_engine)
+
+    # build/configuration identity for the fleet view: one static
+    # gauge whose labels say which version/backend/flags THIS worker
+    # runs — merged /metrics labels it per worker, so a mixed-version
+    # or mixed-flag supervisor fleet is visible in one scrape
+    from chunky_bits_tpu import __version__ as _pkg_version
+    from chunky_bits_tpu.cluster.tunables import (erasure_code,
+                                                  xor_schedule_enabled)
+
+    obs_metrics.record_build_info(
+        _pkg_version, cluster.tunables.backend or "auto",
+        {
+            "code": erasure_code(),
+            "xor_schedule": "on" if xor_schedule_enabled() else "off",
+            "sendfile": "on" if sendfile else "off",
+            "scrub": "on" if scrub is not None else "off",
+            "slo": "on" if slo_engine is not None else "off",
+        }, registry)
 
     # PUT ingest compute (per-shard SHA-256 + per-stripe GF encode) runs
     # on the cluster's host pipeline workers, so the event loop's socket
@@ -817,6 +852,31 @@ def make_app(cluster: Cluster,
             text=obs_metrics.render_exposition(merged),
             content_type="text/plain", charset="utf-8")
 
+    async def handle_alerts(request: web.Request) -> web.Response:
+        """SLO alert states as JSON (obs/slo.py).  ``enabled: false``
+        when the engine is off (the default — `slo_eval_s` unset).
+        Under a multi-worker supervisor the payload adds the FLEET
+        view, merged from the same snapshot spool as /metrics: per
+        rule, the max state across live workers (firing on any worker
+        means firing fleet-wide), with the per-worker breakdown — a
+        spool-reaped dead worker drops out of the merge, so a crashed
+        sibling can never contribute a stale firing alert."""
+        request["cb_source"] = "meta"
+        if slo_engine is None:
+            return web.json_response({"enabled": False})
+        payload = slo_engine.to_obj()
+        payload["worker"] = worker_id
+        if metrics_spool is not None:
+            from chunky_bits_tpu.obs import slo as obs_slo
+
+            entries = await asyncio.to_thread(
+                obs_metrics.load_spool, metrics_spool)
+            entries = [(wid, snap) for wid, snap in entries
+                       if wid != worker_id]
+            entries.append((worker_id, registry.snapshot()))
+            payload["fleet"] = obs_slo.fleet_alert_states(entries)
+        return web.json_response(payload)
+
     async def handle_stats(request: web.Request) -> web.Response:
         """JSON snapshot twin of /metrics (this worker only — machine
         consumers wanting the fleet read /metrics), plus the access-log
@@ -828,6 +888,10 @@ def make_app(cluster: Cluster,
             "requests": request_stats(
                 profiler.peek_requests()).to_obj(),
             "dropped": profiler.drop_counts(),
+            "slo": ({"enabled": True,
+                     **slo_engine.stats().to_obj()}
+                    if slo_engine is not None
+                    else {"enabled": False}),
             "metrics": registry.snapshot(),
         })
 
@@ -862,6 +926,42 @@ def make_app(cluster: Cluster,
     # to the app's lifecycle so tests and restarts leak nothing
     lag_monitor = obs_metrics.LoopLagMonitor(registry)
     spool_task: dict = {"task": None}
+    slo_task: dict = {"task": None}
+
+    async def _slo_ticker() -> None:
+        """The engine's evaluation cadence: one registry snapshot per
+        `slo_eval_s` into the ring.  Under a supervisor the engine
+        evaluates the WORKER-LABELED fleet view (this worker live +
+        siblings off the spool, every sample tagged `worker=` — NOT
+        the summed /metrics merge, whose per-series reset clamp would
+        misread one sibling's restart as a fleet-lifetime delta), so
+        fleet-level rules (worker_down, summed burn rates) see the
+        whole gateway, a restarted sibling clamps to its own small
+        post-reset values, and a reaped sibling contributes nothing."""
+        from chunky_bits_tpu.obs import slo as obs_slo
+
+        while True:
+            try:
+                own = registry.snapshot()
+                if metrics_spool is not None:
+                    entries = await asyncio.to_thread(
+                        obs_metrics.load_spool, metrics_spool)
+                    entries = [(wid, s) for wid, s in entries
+                               if wid != worker_id]
+                    entries.append((worker_id, own))
+                    snap = obs_slo.worker_labeled_snapshot(entries)
+                else:
+                    snap = own
+                slo_engine.observe(snap)
+            # one bad beat (torn spool file mid-teardown, a foreign
+            # snapshot shape from a mixed-version sibling) must not
+            # silently kill alerting for the process lifetime — same
+            # guard discipline as _spool_writer: log, retry next tick
+            # lint: broad-except-ok degrade-never-die heartbeat; the
+            # failure is logged and the next tick retries
+            except Exception as err:
+                log.warning("slo evaluation tick failed: %s", err)
+            await asyncio.sleep(slo_eval)
 
     async def _spool_writer() -> None:
         path = os.path.join(metrics_spool, f"worker-{worker_id}.json")
@@ -882,14 +982,17 @@ def make_app(cluster: Cluster,
         lag_monitor.start(asyncio.get_running_loop())
         if metrics_spool is not None:
             spool_task["task"] = asyncio.ensure_future(_spool_writer())
+        if slo_engine is not None:
+            slo_task["task"] = asyncio.ensure_future(_slo_ticker())
 
     async def _on_cleanup(app: web.Application) -> None:
         lag_monitor.stop()
-        task = spool_task["task"]
-        if task is not None:
-            task.cancel()
-            await asyncio.gather(task, return_exceptions=True)
-            spool_task["task"] = None
+        for holder in (spool_task, slo_task):
+            task = holder["task"]
+            if task is not None:
+                task.cancel()
+                await asyncio.gather(task, return_exceptions=True)
+                holder["task"] = None
 
     app = web.Application(middlewares=[access_log])
     app[PROFILER_KEY] = profiler
@@ -898,9 +1001,10 @@ def make_app(cluster: Cluster,
     app.on_cleanup.append(_on_cleanup)
     # registered before the catch-all: these endpoints shadow objects
     # literally named "scrub/status", "metrics", "stats", "healthz",
-    # "debug/traces" (documented deviation — the reference's gateway
-    # has no non-object routes at all)
+    # "alerts", "debug/traces" (documented deviation — the reference's
+    # gateway has no non-object routes at all)
     app.router.add_get("/scrub/status", handle_scrub_status)
+    app.router.add_get("/alerts", handle_alerts)
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/stats", handle_stats)
     app.router.add_get("/healthz", handle_healthz)

@@ -10,11 +10,19 @@ Prometheus text at gateway ``GET /metrics`` and JSON at ``GET /stats``;
 pipeline's worker threads, and the network fetches, into a bounded
 slowest-N buffer served at ``GET /debug/traces``.
 
-Both modules are stdlib-only and import nothing from the rest of the
-package, so every layer (file/, parallel/, cluster/, gateway/) may feed
-them without import cycles, and the linter (which must run with the
-tunnel down and no third-party deps) can scan them like any other
-module.
+``obs.slo`` is the windowed layer on top of the registry: burn-rate
+SLO rules over a bounded snapshot ring, the pending→firing→resolved
+alert state machine behind gateway ``GET /alerts``, and the
+simulator-verified detection verdicts (sim/scenario.py runs the same
+engine in virtual time).
+
+All three modules are stdlib-only and import nothing from the rest of
+the package (``obs.slo`` reads time through the clock seam's
+stdlib-only implementation half, ``utils/clock.py`` — the same
+cycle-hygiene import file/profiler.py uses), so every layer (file/,
+parallel/, cluster/, gateway/, sim/) may feed them without import
+cycles, and the linter (which must run with the tunnel down and no
+third-party deps) can scan them like any other module.
 """
 
 from chunky_bits_tpu.obs import metrics, tracing  # noqa: F401

@@ -390,6 +390,31 @@ def record_io(op: str, ok: bool, nbytes: int, duration: float) -> None:
             labels=("op",))).labels(op=op).inc(max(nbytes, 0))
 
 
+def record_build_info(version: str, backend: str, flags: dict,
+                      registry: Optional[MetricsRegistry] = None
+                      ) -> None:
+    """Publish the ``cb_build_info`` static-info gauge: value 1, with
+    the process's version, erasure backend, and active tunable flags as
+    labels.  The point is the FLEET view: gauges gain a ``worker``
+    label in the spool merge, so one ``/metrics`` scrape of a
+    supervisor fleet shows exactly which worker runs which version and
+    configuration — a mixed-version or mixed-flag rollout is visible at
+    a glance instead of invisible until it bites.
+
+    Labels are CB107-closed by construction: ``version`` is the baked
+    package version, ``backend`` comes from cluster config, and every
+    flag value is a closed token ("on"/"off", a KNOWN_CODES member) —
+    the caller clamps, like ``record_request`` does."""
+    labels = {"version": str(version), "backend": str(backend or "auto")}
+    for key in sorted(flags):
+        labels[str(key)] = str(flags[key])
+    (registry or get_registry()).gauge(
+        "cb_build_info",
+        "build/configuration identity (value is always 1)",
+        labels=tuple(labels),
+    ).labels(**labels).set(1)
+
+
 def record_dropped(kind: str, n: int = 1) -> None:
     """Ring-buffer drop accounting (``Profiler``'s bounded logs)."""
     kind = kind if kind in ("requests", "entries", "location_failures") \
@@ -471,11 +496,20 @@ def _source_families(reg: MetricsRegistry) -> list[dict]:
     healths = [h.stats().to_obj() for h in reg._live_sources("health")]
     if healths:
         hsum = _sum_rows(healths, ("hedges_fired", "hedges_won",
-                                   "hedges_cancelled"))
+                                   "hedges_cancelled", "primaries"))
         for key in ("hedges_fired", "hedges_won", "hedges_cancelled"):
             fams.append(_fam(f"cb_{key}_total", COUNTER,
                              f"hedged reads: {key.replace('_', ' ')}",
                              [_scalar(hsum[key])]))
+        # the budget denominator, exported so the SLO engine's
+        # hedge-exhaustion rule (obs/slo.py) evaluates EXACTLY the
+        # scoreboard's amplification bound: fired <= ratio*primaries
+        # + burst — fired/primaries sustained at the slope means the
+        # budget is pinned at its cap
+        fams.append(_fam("cb_hedge_primaries_total", COUNTER,
+                         "primary (non-hedge) chunk fetches — the "
+                         "hedge-budget accrual denominator",
+                         [_scalar(hsum["primaries"])]))
         nodes: dict[str, dict] = {}
         for h in healths:
             for row in h["locations"]:
@@ -800,6 +834,17 @@ def parse_exposition(text: str) -> dict:
     for base, kind in types.items():
         out[base] = {"type": kind, "samples": samples.get(base, [])}
     return out
+
+
+def find_family(snapshot: dict, name: str) -> Optional[dict]:
+    """The one family-by-name lookup over a snapshot's ``families``
+    list — shared by the SLO engine's windowed views (obs/slo.py) and
+    the stats CLI's renderer, so a future snapshot-schema change has
+    exactly one scan to update."""
+    for fam in snapshot.get("families", ()):
+        if fam.get("name") == name:
+            return fam
+    return None
 
 
 def histogram_quantile(bounds: Sequence[float], counts: Sequence[int],

@@ -19,6 +19,15 @@ plumbing every scenario needs:
   within the token-bucket budget, repair bytes within the config-11/13
   structural bounds (copy ≤ 1x, decode = d x, msr = 2x — exact
   per-plan accounting, never estimates);
+* **SLO detection verdicts** — every scenario runs the production SLO
+  engine (obs/slo.py) over its fresh registry, ticked in virtual time;
+  the scenario's ``slo`` spec names which alerts MUST fire (within a
+  bounded virtual-time detection latency of the scripted fault, and
+  resolve after convergence) and the engine must stay silent otherwise
+  (zero firing onsets outside fault windows + grace — deterministic
+  precision AND recall for the whole alerting stack, something a real
+  cluster can never prove).  Alert transitions are trace events, so
+  detection latency is part of the byte-identical determinism pin;
 * the **event trace** — every fabric state transition, scripted
   action, client error and verdict as one canonical JSON line with its
   virtual timestamp.  Same seed ⇒ byte-identical trace and equal
@@ -184,7 +193,16 @@ class ScenarioEnv:
         self._client_errors: list[tuple[float, str, str]] = []
         self.client_reads = 0
         self._fault_windows: list[list[float]] = []
+        self._fault_begins: list[float] = []  # raw (non-backdated)
         self.verdicts: dict[str, bool] = {}
+        # SLO engine plumbing (start_slo)
+        self.slo_engine = None
+        self.slo_spec: dict = {}
+        self._slo_task: Optional[asyncio.Task] = None
+        self._slo_tick_s = 15.0
+        #: every alert state transition as (virtual t, rule, old, new)
+        #: — the detection-verdict input, also traced
+        self.alert_transitions: list[tuple[float, str, str, str]] = []
 
     # ---- tracing / verdicts ----
 
@@ -209,7 +227,10 @@ class ScenarioEnv:
         lands is timestamped at ITS start, and an error it takes from
         the freshly-injected fault belongs to the window, not to the
         healthy period before it (the end edge gets the symmetric
-        treatment via ``fault_end``'s grace)."""
+        treatment via ``fault_end``'s grace).  The RAW begin time is
+        kept separately: it is the zero point SLO detection latency is
+        measured from."""
+        self._fault_begins.append(self.now())
         self._fault_windows.append(
             [self.now() - backdate_s, float("inf")])
 
@@ -287,6 +308,153 @@ class ScenarioEnv:
             await task
         except asyncio.CancelledError:
             pass
+
+    # ---- SLO engine (the detection-quality harness) ----
+
+    def start_slo(self, spec: Optional[dict] = None) -> None:
+        """Run the production SLO engine (obs/slo.py) over this
+        scenario's fresh registry, ticked every ``tick_s`` VIRTUAL
+        seconds.  ``spec``:
+
+        * ``expected`` — ``{rule: {"within_s": N, "resolve": bool}}``:
+          alerts that MUST fire within N virtual seconds of the first
+          raw ``fault_begin`` (and, when ``resolve`` is true, be
+          resolved again by scenario end);
+        * ``objectives`` — SloObjectives overrides (a scenario is an
+          operator tuning windows to its fleet's shape);
+        * ``tick_s`` — evaluation cadence (default 15 s);
+        * ``grace_s`` — how far past a fault window's close an
+          expected rule's firing onset may lag (windowed detection
+          trails the fault; default ``slow_s + clear_s``).
+
+        Every transition lands in the event trace, so detection
+        latency is part of the byte-identical determinism pin."""
+        from chunky_bits_tpu.obs import slo as obs_slo
+
+        self.slo_spec = dict(spec or {})
+        objectives = obs_slo.SloObjectives.from_obj(
+            self.slo_spec.get("objectives") or None)
+        self._slo_tick_s = float(self.slo_spec.get("tick_s", 15.0))
+
+        def on_transition(rule: str, old: str, new: str, t: float,
+                          value) -> None:
+            self.alert_transitions.append((t, rule, old, new))
+            self.trace.record(t, "alert", {
+                "rule": rule, "from": old, "to": new,
+                "value": None if value is None else round(value, 6)})
+
+        self.slo_engine = obs_slo.SloEngine(
+            objectives=objectives,
+            registry=obs_metrics.get_registry(),
+            on_transition=on_transition)
+
+        async def ticker() -> None:
+            while True:
+                self.slo_engine.observe()
+                await self.sleep(self._slo_tick_s)
+
+        self._slo_task = asyncio.ensure_future(ticker())
+        self.event("slo_started", tick_s=self._slo_tick_s,
+                   expected=sorted(self.slo_spec.get("expected", {})))
+
+    async def stop_slo(self) -> None:
+        task, self._slo_task = self._slo_task, None
+        if task is None:
+            return
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+
+    async def settle_slo(self) -> None:
+        """Post-driver settle: keep ticking until every expected
+        ``resolve: true`` alert has resolved (bounded — resolution is
+        itself under test, so a stuck alert times out into a failed
+        verdict rather than a hung scenario)."""
+        if self.slo_engine is None:
+            return
+        expected = self.slo_spec.get("expected", {})
+        want_resolved = [rule for rule, cfg in expected.items()
+                         if cfg.get("resolve", True)]
+        obj = self.slo_engine.objectives
+        deadline = self.now() + obj.slow_s + obj.clear_s \
+            + 10.0 * self._slo_tick_s
+        while self.now() < deadline:
+            firing = set(self.slo_engine.firing())
+            if not any(rule in firing for rule in want_resolved):
+                break
+            await self.sleep(self._slo_tick_s)
+
+    def check_slo(self) -> None:
+        """The per-rule detection verdicts (every scenario reports
+        them — run_scenario calls this after the driver and settle):
+
+        * ``slo_detected_<rule>`` for each expected rule: it fired,
+          its first firing onset lies within ``within_s`` of the first
+          raw ``fault_begin``, and (when ``resolve`` is true) it is
+          resolved by scenario end;
+        * ``slo_no_false_positives``: ZERO firing onsets outside the
+          scripted fault windows + grace — the precision half of
+          detection quality.  A non-expected rule firing INSIDE a
+          declared window is a co-detection, not noise (an AZ outage
+          legitimately pins the hedge budget too when hedging is
+          armed); scenarios with no fault window at all are pure
+          silence checks, where any firing is a false positive."""
+        if self.slo_engine is None:
+            return
+        from chunky_bits_tpu.obs import slo as obs_slo
+
+        expected: dict = self.slo_spec.get("expected", {})
+        obj = self.slo_engine.objectives
+        grace_s = float(self.slo_spec.get(
+            "grace_s", obj.slow_s + obj.clear_s))
+        fault_t0 = (self._fault_begins[0]
+                    if self._fault_begins else None)
+        onsets: dict[str, list[float]] = {}
+        for t, rule, _old, new in self.alert_transitions:
+            if new == obs_slo.FIRING:
+                onsets.setdefault(rule, []).append(t)
+        final = {a.rule: a.state for a in self.slo_engine.alerts()}
+        detect_latency: dict[str, float] = {}
+        for rule, cfg in sorted(expected.items()):
+            fired = onsets.get(rule, [])
+            within = float(cfg.get("within_s", 600.0))
+            t0 = fault_t0 if fault_t0 is not None else 0.0
+            in_time = bool(fired) and t0 <= fired[0] <= t0 + within
+            if fired:
+                detect_latency[rule] = round(fired[0] - t0, 3)
+            resolved_ok = True
+            if cfg.get("resolve", True):
+                resolved_ok = final.get(rule) == obs_slo.INACTIVE
+            self.verdict(
+                f"slo_detected_{rule}", in_time and resolved_ok,
+                fired_at=(round(fired[0], 3) if fired else None),
+                fault_t0=(round(t0, 3)),
+                within_s=within,
+                latency_s=detect_latency.get(rule),
+                resolved=final.get(rule) == obs_slo.INACTIVE,
+                resolve_required=cfg.get("resolve", True))
+        false_positives = []
+        for rule, times in sorted(onsets.items()):
+            for t in times:
+                in_window = any(lo <= t <= hi + grace_s
+                                for lo, hi in self._fault_windows)
+                if not in_window:
+                    false_positives.append((rule, round(t, 3)))
+        self.verdict("slo_no_false_positives", not false_positives,
+                     false_positives=false_positives,
+                     evaluations=self.slo_engine.stats().evaluations)
+        self._slo_report = {
+            "detect_latency_s": detect_latency,
+            "false_positives": len(false_positives),
+            "transitions": len(self.alert_transitions),
+            "expected": sorted(expected),
+        }
+
+    def slo_report(self) -> dict:
+        """The config-15 row fields (empty when no engine ran)."""
+        return dict(getattr(self, "_slo_report", {}) or {})
 
     # ---- scrub/repair plane ----
 
@@ -473,6 +641,7 @@ class ScenarioEnv:
 
     async def close(self) -> None:
         await self.stop_client()
+        await self.stop_slo()
         await self.stop_scrub()
         await self.cluster.tunables.location_context().aclose()
         self.fabric.close()
@@ -600,14 +769,26 @@ async def _pm_msr_restart_repair(env: ScenarioEnv) -> None:
 
 async def _thundering_herd(env: ScenarioEnv) -> None:
     """Everyone wants the same object while one of its replica nodes
-    straggles: hedges fire, but the token-bucket budget must cap
-    amplification at ratio x primaries + burst even under a herd."""
+    straggles pathologically: hedges fire, the token-bucket budget
+    must cap amplification at ratio x primaries + burst even under a
+    herd — and the hedge-exhaustion alert must SEE the bucket pinned
+    at its cap (fired/primaries sustained at the budget slope)."""
     fab = env.fabric
     hot = sorted(env.contents)[0]
-    # slow a node that actually serves the hot object
+    # slow a node that actually serves the hot object — and make it a
+    # pathological straggler: x400 puts its reads far past the
+    # adaptive hedge-delay CEILING (20x the floor), so every read of
+    # the hot part is hedge-worthy and the token bucket pins at its
+    # cap (a merely-2x-slow node hides under the adaptive p95 — the
+    # tail-only hedging the budget design intends)
     locs = await env._locations_of(hot)
     node, _ = fabric_mod.resolve(locs[0][2])
+    node.slow_factor = 400.0
     node.set_state(fabric_mod.SLOW)
+    # a straggler this bad is a fault the operator declared: reads
+    # still succeed (slow, never an error), but the hedge-exhaustion
+    # alert belongs to this window
+    env.fault_begin(backdate_s=5.0)
     env.event("herd_begin", object=hot, slow_node=node.node_id)
 
     async def one_reader(i: int) -> None:
@@ -623,6 +804,7 @@ async def _thundering_herd(env: ScenarioEnv) -> None:
             task.cancel()
     node.set_state(fabric_mod.HEALTHY)
     env.event("herd_end")
+    env.fault_end(grace_s=30.0)
     env.check_reads_clean()  # a stall is slow, never an error
     env.check_hedge_budget()
     board = env.cluster.health_scoreboard().stats()
@@ -735,19 +917,81 @@ async def _slow_leak(env: ScenarioEnv) -> None:
     env.check_repair_bytes()
 
 
+async def _fleet_partition(env: ScenarioEnv) -> None:
+    """Total connectivity loss: every zone partitions away while the
+    continuous scrub runs.  The chunk bytes are all intact — the only
+    thing wrong is reachability — so the correct repair response is
+    NOTHING (re-placement escalation parked beyond the outage), and
+    the observability story is the point: the scrub-progress-stall
+    rule must detect a daemon that is up but verifying zero bytes, the
+    breaker plane must mark the fleet degraded, and both alerts must
+    resolve once connectivity returns and the namespace re-verifies
+    Valid."""
+    fab = env.fabric
+    # a 1 s request timeout against unreachable peers (the fabric's
+    # default 5 s stall models a patient client; an operator running
+    # continuous scrub tightens it): at N=100 a 5 s stall per
+    # partitioned read would stretch one scrub pass past the whole
+    # outage, and the breaker plane would see too few consecutive
+    # failures per node to trip before the heal
+    for node in fab.nodes.values():
+        node.partition_stall_s = 1.0
+    env.start_scrub(replace_after_s=36000.0)
+    # warm passes: the stall rule needs a progressing baseline first
+    await env.sleep(180.0)
+    env.fault_begin()
+    env.event("fleet_partition_begin")
+    for zone in fab.zones:
+        fab.set_zone_state(zone, fabric_mod.PARTITIONED)
+    await env.sleep(900.0)
+    for zone in fab.zones:
+        fab.set_zone_state(zone, fabric_mod.RECOVERING)
+    env.event("fleet_partition_end")
+    env.fault_end(grace_s=120.0)
+    # post-heal scrub passes BEFORE stopping: breakers only recover on
+    # traffic (a half-open probe needs a request to ride), and the
+    # scrub walk is the traffic source this clientless scenario has —
+    # exactly the operational reason a real fleet keeps scrub running
+    # after an outage
+    await env.sleep(300.0)
+    converged = await env.wait_converged(1500.0)
+    await env.stop_scrub()
+    env.verdict("converged", converged)
+    env.check_repair_bytes()
+
+
 @dataclass(frozen=True)
 class Scenario:
     name: str
     driver: Callable[[ScenarioEnv], Awaitable[None]]
     #: ScenarioEnv overrides (geometry, knobs) this scenario needs
     env: dict
+    #: SLO detection spec (ScenarioEnv.start_slo): which alerts MUST
+    #: fire (with detection bounds), objective overrides, tick cadence.
+    #: Empty = pure precision check — the engine still runs and ZERO
+    #: alerts may fire.
+    slo: dict = field(default_factory=dict)
 
 
 SCENARIOS: dict[str, Scenario] = {
     s.name: s for s in (
+        # a third of the fleet partitions: the breaker plane must mark
+        # it degraded (fraction over the 0.3 objective) within the
+        # persistence window, and recover once the zone returns.  The
+        # detection bound tracks fleet-scale physics: each partitioned
+        # node trips after 5 consecutive failures, accumulated at the
+        # scrub pass cadence, and partitioned reads stall 5 s each —
+        # at N=100 one pass spans several virtual minutes, so the
+        # fraction crosses the objective a few passes into the outage
         Scenario("az_outage", _az_outage, {
             "scrub_bytes_per_sec": 50e6, "scrub_interval_s": 60.0,
+        }, slo={
+            "expected": {"breaker_open": {"within_s": 1500.0,
+                                          "resolve": True}},
+            "objectives": {"breaker_node_fraction": 0.3},
         }),
+        # restarts are routine, not faults: the engine must stay
+        # SILENT through a quarter-fleet rolling restart (precision)
         Scenario("rolling_restart", _rolling_restart, {
             "scrub_bytes_per_sec": 50e6, "scrub_interval_s": 120.0,
         }),
@@ -756,17 +1000,53 @@ SCENARIOS: dict[str, Scenario] = {
             "objects": 8,
             "scrub_bytes_per_sec": 50e6, "scrub_interval_s": 90.0,
         }),
+        # a herd against a straggler pins the hedge token bucket at
+        # its cap: the hedge-exhaustion rule must see fired/primaries
+        # at the budget slope (tight windows — the herd lives seconds)
         Scenario("thundering_herd", _thundering_herd, {
             "hedge_ms": 25.0, "objects": 8,
+        }, slo={
+            "expected": {"hedge_exhaustion": {"within_s": 60.0,
+                                              "resolve": True}},
+            "objectives": {"fast_s": 5.0, "slow_s": 10.0,
+                           "clear_s": 10.0},
+            "tick_s": 1.0,
         }),
+        # disks die for good: the planner's re-placement escalation IS
+        # the repair-fallback-storm signal (resolves once re-placed);
+        # the dead zone is ~a tenth of the fleet, so breaker_open must
+        # NOT fire at the 0.3 objective
         Scenario("correlated_failures", _correlated_failures, {
             "scrub_bytes_per_sec": 50e6, "scrub_interval_s": 90.0,
+        }, slo={
+            "expected": {"repair_fallback_storm": {"within_s": 900.0,
+                                                   "resolve": True}},
         }),
+        # one flapping node of many: below every fraction objective —
+        # the engine must stay silent while the breaker does its job
         Scenario("flapping_node", _flapping_node, {
             "objects": 12,
         }),
+        # latent corruption drips in and scrub keeps up: progress
+        # never stalls, no storms — silence is the correct verdict
         Scenario("slow_leak", _slow_leak, {
             "scrub_bytes_per_sec": 50e6, "scrub_interval_s": 45.0,
+        }),
+        # total connectivity loss: scrub-progress stall, fleet-wide
+        # breaker degradation, AND the planner's fallback storm (every
+        # pass hands every unreachable part back to the classic
+        # resilver) — all three detected, all three resolving after
+        # the heal
+        Scenario("fleet_partition", _fleet_partition, {
+            "scrub_bytes_per_sec": 50e6, "scrub_interval_s": 60.0,
+        }, slo={
+            "expected": {
+                "scrub_stall": {"within_s": 600.0, "resolve": True},
+                "breaker_open": {"within_s": 600.0, "resolve": True},
+                "repair_fallback_storm": {"within_s": 300.0,
+                                          "resolve": True},
+            },
+            "objectives": {"scrub_stall_s": 240.0},
         }),
     )
 }
@@ -810,7 +1090,15 @@ def run_scenario(name: str, *, nodes: int = 100, seed: int = 0,
             env.event("scenario_begin", scenario=name, nodes=nodes,
                       seed=seed)
             await env.write_namespace()
+            # EVERY scenario runs the SLO engine — scenarios with no
+            # `slo` spec are precision runs (zero alerts may fire);
+            # started after the namespace write so the warmup I/O burst
+            # is not part of the observed story, before the driver so
+            # the quiet period ahead of the fault is
+            env.start_slo(scenario.slo)
             await scenario.driver(env)
+            await env.settle_slo()
+            env.check_slo()
             env.event("scenario_end", scenario=name)
             virtual = env.now()
             metrics = obs_metrics.get_registry().snapshot()
@@ -831,7 +1119,8 @@ def run_scenario(name: str, *, nodes: int = 100, seed: int = 0,
         metrics=metrics,
         verdicts=dict(env.verdicts),
         details={"client_reads": env.client_reads,
-                 "fabric": env.fabric.stats()},
+                 "fabric": env.fabric.stats(),
+                 "slo": env.slo_report()},
     )
 
 

@@ -1,10 +1,13 @@
 #!/usr/bin/env python
-"""CI metrics-scrape smoke: boot a throwaway gateway on a temp
-cluster, drive one PUT/GET, scrape /metrics + /healthz + /stats, and
-validate the exposition against the strict line grammar
-(chunky_bits_tpu.obs.metrics.parse_exposition — the same parser the
-tests and `chunky-bits stats` use).  Exit 0 with "metrics smoke OK" on
-success; any grammar violation or missing family fails the step.
+"""CI metrics-scrape smoke: boot a throwaway gateway (SLO engine ON at
+a fast tick) on a temp cluster, drive one PUT/GET, scrape /metrics +
+/healthz + /stats + /alerts, validate the exposition against the
+strict line grammar (chunky_bits_tpu.obs.metrics.parse_exposition —
+the same parser the tests and `chunky-bits stats` use), and
+schema-check the /alerts and /stats payloads (closed rule set, the
+slo stanza, the build-info identity gauge).  Exit 0 with "metrics
+smoke OK" on success; any grammar violation, missing family, or
+schema miss fails the step.
 
 Run: python scripts/metrics_smoke.py
 """
@@ -29,11 +32,14 @@ REQUIRED_FAMILIES = (
     "cb_request_total",
     "cb_request_bytes_total",
     "cb_worker_up",
+    "cb_build_info",
     "cb_cache_hits_total",
     "cb_pipeline_jobs_total",
     "cb_node_completions_total",
     "cb_eventloop_lag_seconds",
     "cb_gateway_gets_in_flight",
+    "cb_alerts_state",
+    "cb_slo_evaluations_total",
 )
 
 
@@ -59,7 +65,9 @@ async def main() -> int:
                          "path": meta},
             "profiles": {"default": {"data": 3, "parity": 2,
                                      "chunk_size": 16}},
-            "tunables": {"cache_bytes": 4 << 20},
+            # engine ON at a fast tick so /alerts answers with live
+            # state and the cb_slo_*/cb_alerts_* families are scraped
+            "tunables": {"cache_bytes": 4 << 20, "slo_eval_s": 0.2},
         })
         server = TestServer(make_app(cluster))
         await server.start_server()
@@ -73,9 +81,30 @@ async def main() -> int:
                 assert await resp.read() == payload
                 resp = await session.get(f"{url}/healthz")
                 assert resp.status == 200, resp.status
+                await asyncio.sleep(0.5)  # at least one engine tick
                 resp = await session.get(f"{url}/stats")
                 stats = await resp.json()
                 assert stats["requests"]["count"] >= 2, stats
+                # /stats slo stanza schema
+                slo = stats.get("slo", {})
+                assert slo.get("enabled") is True, stats
+                for key in ("evaluations", "firing", "pending",
+                            "resolved_total"):
+                    assert key in slo, slo
+                assert slo["evaluations"] >= 1, slo
+                # /alerts schema: the closed rule set, every row shaped
+                resp = await session.get(f"{url}/alerts")
+                assert resp.status == 200, resp.status
+                alerts = await resp.json()
+                assert alerts.get("enabled") is True, alerts
+                from chunky_bits_tpu.obs.slo import ALERT_STATES, RULES
+                rows = {a["rule"]: a for a in alerts["alerts"]}
+                assert set(rows) == set(RULES), sorted(rows)
+                for a in rows.values():
+                    assert a["state"] in ALERT_STATES, a
+                    for key in ("since", "threshold", "fired_count"):
+                        assert key in a, a
+                assert alerts["firing"] == [], alerts["firing"]
                 resp = await session.get(f"{url}/metrics")
                 assert resp.status == 200, resp.status
                 parsed = parse_exposition(await resp.text())

@@ -336,6 +336,62 @@ def test_config14_failure_emits_one_json_line():
     assert "error" in rec
 
 
+def test_config15_smoke_emits_one_json_line():
+    """--config 15 --smoke (SLO detection quality + engine-off
+    overhead A/B at CI scale) honors the driver contract: exactly one
+    parseable JSON line on stdout with the required keys, exit 0 —
+    and the run itself asserts every expected alert detected within
+    its virtual-time bound, ZERO false positives across the suite,
+    the same-seed determinism double-run (alert trace included), and
+    the engine-on gateway within a loose throughput floor of
+    engine-off."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "15", "--smoke"],
+        cwd=REPO, env=env, capture_output=True, timeout=300)
+    assert r.returncode == 0, r.stderr.decode()[-800:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "nodes",
+                "scenarios", "alerts_expected", "alerts_detected",
+                "false_positives", "deterministic",
+                "detect_latency_s", "rps_off", "rps_on",
+                "on_off_ratio", "rows"):
+        assert key in rec
+    assert rec["unit"] == "s"
+    # the detection-quality contract, observed live: every expected
+    # alert detected (value = worst virtual latency, inside bounds ⇒
+    # margin > 1), zero false positives, deterministic double-run
+    assert rec["value"] > 0
+    assert rec["vs_baseline"] > 1.0
+    assert rec["alerts_detected"] == rec["alerts_expected"] > 0
+    assert rec["false_positives"] == 0
+    assert rec["deterministic"] is True
+    assert rec["on_off_ratio"] > 0.5
+    for row in rec["rows"]:
+        assert row["ok"] is True, row
+
+
+def test_config15_failure_emits_one_json_line():
+    """ANY --config 15 failure (here: an unknown scenario name) still
+    produces exactly one parseable JSON line and exit 3 — the same
+    contract as configs 8-14 and the device runs."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "15",
+         "--scenarios", "heat_death"],
+        cwd=REPO, env=env, capture_output=True, timeout=120)
+    assert r.returncode == 3, r.stderr.decode()[-500:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec
+    assert rec["value"] == 0.0
+    assert "error" in rec
+
+
 def test_seams_only_shrink_and_tolerate_garbage():
     """Inherited env values must not break the contract: malformed or
     larger-than-default values fall back to the real budget."""

@@ -280,21 +280,50 @@ def test_fabric_zone_topology_and_stats():
 # ---- scenario engine ----
 
 #: per-scenario invariant verdicts that MUST appear and hold — the
-#: regression surface for the ISSUE-12 trio and the rest of the library
+#: regression surface for the ISSUE-12 trio, the rest of the library,
+#: and (ISSUE 13) the SLO detection verdicts: every scenario carries
+#: `slo_no_false_positives` (the engine runs everywhere, silence is a
+#: tested property), and scenarios with scripted faults additionally
+#: pin their expected alerts firing within the detection bound and
+#: resolving after convergence
 _KEY_VERDICTS = {
-    # repair waits out the partition: zero classic-resilver fallbacks
+    # repair waits out the partition: zero classic-resilver fallbacks;
+    # a third of the fleet degraded must trip the breaker-open alert
     "az_outage": ("converged", "no_fallback_storm",
-                  "reads_clean_outside_fault"),
-    "rolling_restart": ("converged", "reads_clean_outside_fault"),
+                  "reads_clean_outside_fault",
+                  "slo_detected_breaker_open",
+                  "slo_no_false_positives"),
+    # restarts are routine: the engine must stay SILENT throughout
+    "rolling_restart": ("converged", "reads_clean_outside_fault",
+                        "slo_no_false_positives"),
     # msr plan survives helper churn or falls back cleanly, and every
     # repair byte lands under the pm-msr code label
-    "pm_msr_restart_repair": ("converged", "repair_labeled_pm_msr"),
-    "thundering_herd": ("hedge_within_budget", "herd_reads_served"),
-    "correlated_failures": ("converged", "replaced_lost_chunks"),
+    "pm_msr_restart_repair": ("converged", "repair_labeled_pm_msr",
+                              "slo_no_false_positives"),
+    # the pinned hedge token bucket is an alert, inside the declared
+    # straggler window only
+    "thundering_herd": ("hedge_within_budget", "herd_reads_served",
+                        "slo_detected_hedge_exhaustion",
+                        "slo_no_false_positives"),
+    # dead disks: the planner's re-placement escalation IS the
+    # fallback-storm signal (and it resolves once re-placed)
+    "correlated_failures": ("converged", "replaced_lost_chunks",
+                            "slo_detected_repair_fallback_storm",
+                            "slo_no_false_positives"),
     # an open breaker may never strand a live node at zero traffic:
-    # the half-open probe recovers it once the flapping stops
-    "flapping_node": ("breaker_recovered", "traffic_returned"),
-    "slow_leak": ("converged", "corruption_detected"),
+    # the half-open probe recovers it once the flapping stops — and
+    # one flapping node of many stays below every alert objective
+    "flapping_node": ("breaker_recovered", "traffic_returned",
+                      "slo_no_false_positives"),
+    "slow_leak": ("converged", "corruption_detected",
+                  "slo_no_false_positives"),
+    # total connectivity loss: scrub-stall + breaker + fallback-storm
+    # all detected, all resolved after the heal
+    "fleet_partition": ("converged",
+                        "slo_detected_scrub_stall",
+                        "slo_detected_breaker_open",
+                        "slo_detected_repair_fallback_storm",
+                        "slo_no_false_positives"),
 }
 
 
@@ -375,3 +404,44 @@ def test_scenario_result_shape(tmp_path):
 def test_unknown_scenario_fails_loudly(tmp_path):
     with pytest.raises(ValueError, match="unknown scenario"):
         run_scenario("heat_death", workdir=str(tmp_path))
+
+
+# ---- SLO detection quality (ISSUE 13) ----
+
+def test_every_scenario_reports_slo_verdicts(tmp_path):
+    """The acceptance criterion's shape half: EVERY scenario runs the
+    engine and reports `slo_no_false_positives`, scenarios with a spec
+    report one `slo_detected_<rule>` per expected rule, and the result
+    row carries the detection-latency report bench --config 15 emits.
+    (The verdicts HOLDING is pinned per scenario in _KEY_VERDICTS.)"""
+    result = run_scenario("fleet_partition", nodes=12, seed=0,
+                          workdir=str(tmp_path), objects=6)
+    assert "slo_no_false_positives" in result.verdicts
+    spec = SCENARIOS["fleet_partition"].slo
+    for rule in spec["expected"]:
+        assert f"slo_detected_{rule}" in result.verdicts
+    report = result.details["slo"]
+    assert report["false_positives"] == 0
+    for rule, bound in ((r, c["within_s"])
+                        for r, c in spec["expected"].items()):
+        assert 0.0 < report["detect_latency_s"][rule] <= bound
+    # alert transitions are trace events — part of the determinism pin
+    assert result.trace.count(b'"event":"alert"') \
+        == report["transitions"]
+
+
+def test_detection_latency_is_deterministic(tmp_path):
+    """Same seed ⇒ identical detection latencies (the general trace
+    pin covers this byte-for-byte; this pins the derived numbers the
+    config-15 row reports, so a refactor of the report cannot silently
+    decouple them from the trace)."""
+    runs = []
+    workdir = str(tmp_path / "det")
+    for _ in range(2):
+        fresh_workdir(workdir)
+        runs.append(run_scenario("thundering_herd", nodes=12, seed=0,
+                                 workdir=workdir, objects=6))
+    a, b = runs
+    assert a.details["slo"] == b.details["slo"]
+    assert a.details["slo"]["detect_latency_s"], "expected a detection"
+    assert a.trace == b.trace

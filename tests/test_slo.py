@@ -1,0 +1,680 @@
+"""SLO engine (chunky_bits_tpu/obs/slo.py): windowed views, burn-rate
+rules, the alert state machine, fleet aggregation, and the gateway
+surfaces.
+
+Four layers, matching the engine's pieces:
+
+* **histogram_quantile edge cases** — the SLO rules made its return
+  values operationally load-bearing, so the empty / all-mass-in-+Inf /
+  single-sample branches are pinned here (they were untested before);
+* **SnapshotRing** — windowed counter/histogram deltas, the
+  young-ring insufficient-data contract, and THE worker-restart
+  semantics: a cumulative series that went down restarted, and its
+  windowed delta is the post-reset end value, never negative;
+* **the state machine** — multi-window gating (a fast-window spike
+  alone never fires), pending with ``for_s``, hold-down hysteresis on
+  resolve, the bounded firing-history ring;
+* **fleet + gateway** — ``fleet_alert_states`` (firing on one worker
+  ⇒ firing fleet-wide; a spool-reaped dead worker contributes
+  nothing), ``GET /alerts`` on and off, the ``/stats`` slo stanza,
+  ``cb_build_info``, and the ``Slo<...>`` profiler stanza.
+
+The detection-quality half (expected alerts firing inside virtual-time
+bounds on the simulator) lives in tests/test_sim.py — this file is the
+engine's own contract.
+"""
+
+import asyncio
+import io
+import math
+import os
+
+import pytest
+
+from chunky_bits_tpu.obs import metrics as obs_metrics
+from chunky_bits_tpu.obs import slo as obs_slo
+from chunky_bits_tpu.obs.metrics import (
+    MetricsRegistry,
+    histogram_quantile,
+    parse_exposition,
+)
+from chunky_bits_tpu.obs.slo import (
+    FIRING,
+    INACTIVE,
+    PENDING,
+    RULES,
+    SloEngine,
+    SloObjectives,
+    SnapshotRing,
+    fleet_alert_states,
+)
+
+
+def make_cluster(tmp_path, **tunables):
+    from chunky_bits_tpu.cluster import Cluster
+
+    dirs = []
+    for i in range(5):
+        d = tmp_path / f"disk{i}"
+        d.mkdir(exist_ok=True)
+        dirs.append(str(d))
+    meta = tmp_path / "meta"
+    meta.mkdir(exist_ok=True)
+    return Cluster.from_obj({
+        "destinations": [{"location": d} for d in dirs],
+        "metadata": {"type": "path", "format": "yaml",
+                     "path": str(meta)},
+        "profiles": {"default": {"data": 3, "parity": 2,
+                                 "chunk_size": 16}},
+        "tunables": tunables,
+    })
+
+
+# ---- histogram_quantile edge cases (now load-bearing) ----
+
+def test_histogram_quantile_empty_is_zero():
+    assert histogram_quantile((0.1, 1.0), [0, 0, 0], 99.0) == 0.0
+    assert histogram_quantile((), [], 50.0) == 0.0
+
+
+def test_histogram_quantile_all_mass_in_inf_bucket():
+    """Every observation above the last finite bound: the quantile
+    interpolates inside the synthetic +Inf bucket [lo, 2*lo] (or
+    [0, 1] when no finite bucket ever filled) — finite, monotone in q,
+    never inf/NaN (an alert threshold comparison must stay sane)."""
+    bounds = (0.1, 1.0)
+    counts = [0, 0, 10]
+    q50 = histogram_quantile(bounds, counts, 50.0)
+    q99 = histogram_quantile(bounds, counts, 99.0)
+    assert 1.0 <= q50 <= 2.0 and 1.0 <= q99 <= 2.0
+    assert q50 <= q99
+    assert math.isfinite(q99)
+    # degenerate twin: nothing finite ever observed at all
+    only_inf = histogram_quantile((), [5], 99.0)
+    assert 0.0 <= only_inf <= 1.0 and math.isfinite(only_inf)
+
+
+def test_histogram_quantile_single_sample():
+    """One observation: every quantile lands inside that sample's
+    bucket (linear interpolation between the bucket edges)."""
+    bounds = (0.1, 1.0, 10.0)
+    counts = [0, 1, 0, 0]
+    for q in (1.0, 50.0, 99.9):
+        v = histogram_quantile(bounds, counts, q)
+        assert 0.1 <= v <= 1.0, (q, v)
+
+
+# ---- SnapshotRing ----
+
+def _counter_fam(name, *samples):
+    return {"name": name, "type": "counter", "help": "",
+            "samples": [{"labels": dict(labels), "value": value}
+                        for labels, value in samples]}
+
+
+def _gauge_fam(name, *samples):
+    fam = _counter_fam(name, *samples)
+    fam["type"] = "gauge"
+    return fam
+
+
+def _hist_fam(name, buckets, counts, labels=()):
+    return {"name": name, "type": "histogram", "help": "",
+            "buckets": list(buckets),
+            "samples": [{"labels": dict(labels), "counts": list(counts),
+                         "sum": 0.0, "count": sum(counts)}]}
+
+
+def test_ring_counter_delta_and_window_selection():
+    ring = SnapshotRing()
+    for t, v in ((0, 100), (30, 160), (60, 220)):
+        ring.append({"families": [_counter_fam(
+            "c_total", ((), v))]}, now=t)
+    # window 60: oldest-in-window is t=0 -> delta 120
+    assert ring.counter_delta("c_total", 60) == 120
+    # window 30: oldest-in-window is t=30 -> delta 60
+    assert ring.counter_delta("c_total", 30) == 60
+    # absent family -> None, never 0
+    assert ring.counter_delta("nope_total", 60) is None
+
+
+def test_ring_young_ring_reads_as_no_data():
+    """A ring spanning less than half the window must answer None —
+    a freshly-started worker has no burn rate, not a zero one."""
+    ring = SnapshotRing()
+    ring.append({"families": [_counter_fam("c_total", ((), 5))]},
+                now=0)
+    assert ring.counter_delta("c_total", 60) is None  # single entry
+    ring.append({"families": [_counter_fam("c_total", ((), 9))]},
+                now=10)
+    assert ring.counter_delta("c_total", 60) is None  # span 10 < 30
+    assert ring.counter_delta("c_total", 20) == 4     # span 10 >= 10
+
+
+def test_ring_counter_reset_is_a_fresh_epoch_not_negative():
+    """THE worker-restart contract: a cumulative counter that went
+    DOWN restarted from zero; the windowed delta is the end value."""
+    ring = SnapshotRing()
+    ring.append({"families": [_counter_fam("c_total", ((), 1000))]},
+                now=0)
+    ring.append({"families": [_counter_fam("c_total", ((), 50))]},
+                now=60)
+    delta = ring.counter_delta("c_total", 60)
+    assert delta == 50, f"restart must read as +50, got {delta}"
+
+
+def test_ring_reset_is_per_label_set():
+    """One worker of a fleet-merged series restarting must not poison
+    the others' deltas: the clamp is per label set."""
+    key_a = (("worker", "a"),)
+    key_b = (("worker", "b"),)
+    ring = SnapshotRing()
+    ring.append({"families": [_counter_fam(
+        "c_total", (key_a, 500), (key_b, 300))]}, now=0)
+    ring.append({"families": [_counter_fam(
+        "c_total", (key_a, 700), (key_b, 20))]}, now=60)
+    # a: +200 normal; b: reset -> +20 fresh epoch
+    assert ring.counter_delta("c_total", 60) == 220
+
+
+def test_ring_histogram_window_and_reset():
+    ring = SnapshotRing()
+    ring.append({"families": [_hist_fam("h", (0.1, 1.0),
+                                        [10, 5, 1])]}, now=0)
+    ring.append({"families": [_hist_fam("h", (0.1, 1.0),
+                                        [14, 9, 1])]}, now=60)
+    bounds, counts = ring.hist_window("h", 60)
+    assert bounds == [0.1, 1.0] and counts == [4, 4, 0]
+    # any bucket going backwards = the series restarted: window
+    # contribution is the end vector wholesale
+    ring.append({"families": [_hist_fam("h", (0.1, 1.0),
+                                        [2, 1, 0])]}, now=120)
+    _, counts = ring.hist_window("h", 60)
+    assert counts == [2, 1, 0]
+
+
+def test_ring_quantile_over_window():
+    ring = SnapshotRing()
+    ring.append({"families": [_hist_fam("h", (0.1, 1.0),
+                                        [100, 0, 0])]}, now=0)
+    # all NEW mass lands in the (0.1, 1.0] bucket even though the
+    # cumulative total is dominated by old fast samples — the window
+    # view must see only the new mass
+    ring.append({"families": [_hist_fam("h", (0.1, 1.0),
+                                        [100, 50, 0])]}, now=60)
+    q = ring.quantile("h", 99.0, 60)
+    assert 0.1 <= q <= 1.0
+    assert ring.quantile("absent", 99.0, 60) is None
+
+
+def test_ring_gauge_persistence():
+    ring = SnapshotRing()
+
+    def frac(snap):
+        values = ring.gauge_values(snap, "g")
+        if not values:
+            return None
+        return sum(1 for v in values if v >= 1) / len(values)
+
+    for t, states in ((0, (0, 0)), (30, (1, 2)), (60, (1, 2))):
+        ring.append({"families": [_gauge_fam(
+            "g", *(((("node", str(i)),), v)
+                   for i, v in enumerate(states)))]}, now=t)
+    # min over the 60s window includes the healthy t=0 entry
+    assert ring.gauge_persisted(60, frac) == 0.0
+    # a 30s window sees only the degraded entries
+    assert ring.gauge_persisted(30, frac) == 1.0
+
+
+def test_ring_prunes_by_age():
+    """The memory bound that matters at fleet scale: entries older
+    than max_age_s behind the newest are pruned (one boundary entry
+    at/past the cutoff is kept so full-window pairs survive)."""
+    ring = SnapshotRing(max_age_s=100.0)
+    for t in range(0, 1000, 10):
+        ring.append({"families": [_counter_fam("c_total",
+                                               ((), float(t)))]},
+                    now=t)
+    assert len(ring) <= 13  # ~100s/10s + boundary + margin, not 100
+    # windowed reads still work right up to the age bound
+    assert ring.counter_delta("c_total", 100) == 100.0
+
+
+def test_worker_labeled_snapshot_restart_stays_windowed():
+    """THE fleet-evaluation contract: the engine's supervisor input
+    is worker-LABELED, never summed — so one sibling's restart clamps
+    to its own small post-reset series, not to the surviving fleet's
+    lifetime total (which on a summed series would re-fire every
+    ratio rule on every routine restart)."""
+    from chunky_bits_tpu.obs.slo import worker_labeled_snapshot
+
+    def fleet(a_ok, a_err, b_ok, b_err):
+        return worker_labeled_snapshot([
+            ("a", _requests_snap(a_ok, a_err)),
+            ("b", _requests_snap(b_ok, b_err)),
+        ])
+
+    eng = SloEngine(SloObjectives(fast_s=60, slow_s=120),
+                    registry=MetricsRegistry())
+    # worker b carries a large OLD error history (a past outage) that
+    # must never leak into a window after its restart
+    t, a_ok, b_ok, b_err = 0, 10_000, 10_000, 5_000
+    while t <= 120:
+        eng.observe(fleet(a_ok, 0, b_ok, b_err), now=t)
+        t += 30
+        a_ok += 30
+        b_ok += 30
+    assert {x.rule: x.state for x in eng.alerts()}[
+        "availability"] == INACTIVE
+    # b restarts: its cumulative series drop to near zero
+    b_ok, b_err = 5, 0
+    for _ in range(4):
+        eng.observe(fleet(a_ok, 0, b_ok, b_err), now=t)
+        t += 30
+        a_ok += 30
+        b_ok += 30
+    alerts = {x.rule: x for x in eng.alerts()}
+    assert alerts["availability"].state == INACTIVE, (
+        f"restart misread as a burn: {alerts['availability']}")
+    assert (alerts["availability"].value_fast or 0.0) < 0.01
+    # and a REAPED worker (gone from the input entirely) is silent too
+    for _ in range(4):
+        eng.observe(worker_labeled_snapshot(
+            [("a", _requests_snap(a_ok, 0))]), now=t)
+        t += 30
+        a_ok += 30
+    assert {x.rule: x.state for x in eng.alerts()}[
+        "availability"] == INACTIVE
+
+
+def test_worker_labeled_snapshot_shape():
+    from chunky_bits_tpu.obs.slo import worker_labeled_snapshot
+
+    combined = worker_labeled_snapshot([
+        ("a", {"families": [_gauge_fam("cb_worker_up", ((), 1))]}),
+        ("b", {"families": [_gauge_fam("cb_worker_up", ((), 1))]}),
+    ])
+    fam = combined["families"][0]
+    assert fam["name"] == "cb_worker_up"
+    assert sorted(s["labels"]["worker"] for s in fam["samples"]) \
+        == ["a", "b"]
+    assert sum(s["value"] for s in fam["samples"]) == 2
+
+
+# ---- objectives ----
+
+def test_objectives_loud_on_unknown_and_invalid():
+    with pytest.raises(ValueError, match="unknown slo objective"):
+        SloObjectives.from_obj({"tpyo": 1})
+    with pytest.raises(ValueError, match="must be >= 0"):
+        SloObjectives.from_obj({"fast_s": -1})
+    with pytest.raises(ValueError, match="mapping"):
+        SloObjectives.from_obj([1])
+    obj = SloObjectives.from_obj({"fast_s": 30, "min_workers": 2})
+    assert obj.fast_s == 30.0 and obj.min_workers == 2
+    assert SloObjectives.from_obj(
+        obj.to_obj()).to_obj() == obj.to_obj()
+
+
+# ---- the state machine (driven with synthetic snapshots) ----
+
+def _requests_snap(ok_total, err_total):
+    return {"families": [_counter_fam(
+        "cb_request_total",
+        ((("method", "GET"), ("source", "store"),
+          ("status_class", "2xx")), ok_total),
+        ((("method", "GET"), ("source", "-"),
+          ("status_class", "5xx")), err_total))]}
+
+
+def test_fast_window_spike_alone_never_fires():
+    """The multi-window burn-rate gate: a breach must hold over BOTH
+    windows — a young ring (slow window unsatisfied) cannot fire."""
+    eng = SloEngine(SloObjectives(fast_s=60, slow_s=300),
+                    registry=MetricsRegistry())
+    eng.observe(_requests_snap(100, 0), now=0)
+    eng.observe(_requests_snap(150, 50), now=60)  # 33% errors, fast
+    state = {a.rule: a.state for a in eng.alerts()}
+    assert state["availability"] == INACTIVE
+
+
+def test_availability_fires_and_resolves_with_hysteresis():
+    eng = SloEngine(SloObjectives(fast_s=60, slow_s=300, clear_s=120),
+                    registry=MetricsRegistry(),)
+    ok, err, t = 100, 0, 0
+    # sustained 10% error ratio: fires once the slow window fills
+    while t <= 300:
+        eng.observe(_requests_snap(ok, err), now=t)
+        t += 30
+        ok += 27
+        err += 3
+    alerts = {a.rule: a for a in eng.alerts()}
+    assert alerts["availability"].state == FIRING
+    assert alerts["availability"].value_fast == pytest.approx(0.1)
+    fired_at = alerts["availability"].since
+    # errors stop: the alert must HOLD clear_s before resolving
+    clean_since = None
+    while t <= 900:
+        eng.observe(_requests_snap(ok, err), now=t)
+        state = {a.rule: a.state for a in eng.alerts()}
+        ratio = eng.alerts()[0].value_fast
+        if clean_since is None and ratio is not None and ratio < 0.01:
+            clean_since = t
+        if state["availability"] == INACTIVE:
+            break
+        t += 30
+        ok += 30
+    assert {a.rule: a.state for a in eng.alerts()}[
+        "availability"] == INACTIVE
+    assert clean_since is not None
+    assert t - clean_since >= 120, "resolved before the hold-down"
+    history = eng.history()
+    assert len(history) == 1
+    assert history[0]["rule"] == "availability"
+    assert history[0]["fired_at"] == pytest.approx(fired_at)
+    assert history[0]["resolved_at"] is not None
+
+
+def test_pending_state_with_for_s():
+    eng = SloEngine(SloObjectives(fast_s=60, slow_s=60, for_s=60),
+                    registry=MetricsRegistry())
+    ok, err = 100, 0
+    states = []
+    for t in (0, 30, 60, 90, 120, 150):
+        eng.observe(_requests_snap(ok, err), now=t)
+        states.append({a.rule: a.state
+                       for a in eng.alerts()}["availability"])
+        ok += 18
+        err += 2
+    assert PENDING in states and states[-1] == FIRING
+    assert states.index(PENDING) < states.index(FIRING)
+
+
+def test_engine_publishes_closed_label_families():
+    reg = MetricsRegistry()
+    eng = SloEngine(registry=reg)
+    eng.observe({"families": []}, now=0)
+    snap = reg.snapshot()
+    fams = {f["name"]: f for f in snap["families"]}
+    states = fams["cb_alerts_state"]["samples"]
+    assert {s["labels"]["rule"] for s in states} == set(RULES)
+    assert all(s["value"] == 0 for s in states)
+    assert fams["cb_slo_evaluations_total"]["samples"][0]["value"] == 1
+    # and the exposition stays grammar-clean with the engine families
+    parse_exposition(obs_metrics.render_exposition(snap))
+
+
+def test_worker_down_rule_against_min_workers():
+    eng = SloEngine(SloObjectives(fast_s=60, slow_s=60,
+                                  min_workers=2, clear_s=30),
+                    registry=MetricsRegistry())
+    two_up = {"families": [_gauge_fam(
+        "cb_worker_up", ((("worker", "a"),), 1),
+        ((("worker", "b"),), 1))]}
+    one_up = {"families": [_gauge_fam(
+        "cb_worker_up", ((("worker", "a"),), 1))]}
+    for t in (0, 30, 60):
+        eng.observe(two_up, now=t)
+    assert {a.rule: a.state for a in eng.alerts()}[
+        "worker_down"] == INACTIVE
+    for t in (90, 120, 150, 180):
+        eng.observe(one_up, now=t)
+    assert {a.rule: a.state for a in eng.alerts()}[
+        "worker_down"] == FIRING
+
+
+# ---- fleet aggregation ----
+
+def _alerts_snap(**rule_states):
+    return {"families": [_gauge_fam(
+        "cb_alerts_state",
+        *(((("rule", rule),), obs_slo._STATE_RANK[state])
+          for rule, state in rule_states.items()))]}
+
+
+def test_fleet_merge_firing_on_one_worker_is_fleet_firing():
+    merged = fleet_alert_states([
+        ("1001", _alerts_snap(availability=INACTIVE,
+                              breaker_open=INACTIVE)),
+        ("1002", _alerts_snap(availability=FIRING,
+                              breaker_open=PENDING)),
+    ])
+    assert merged["fleet"]["availability"] == FIRING
+    assert merged["fleet"]["breaker_open"] == PENDING
+    assert merged["firing"] == ["availability"]
+    assert merged["workers"]["1002"]["availability"] == FIRING
+    assert merged["workers"]["1001"]["availability"] == INACTIVE
+
+
+def test_fleet_merge_reaped_worker_contributes_nothing():
+    """The supervisor unlinks a dead worker's spool snapshot; the
+    merge input simply no longer contains it — its firing alert is
+    gone from the fleet view on the next scrape."""
+    alive = [("1001", _alerts_snap(availability=INACTIVE))]
+    dead_too = alive + [("1002", _alerts_snap(availability=FIRING))]
+    assert fleet_alert_states(dead_too)["fleet"][
+        "availability"] == FIRING
+    merged = fleet_alert_states(alive)
+    assert merged["fleet"]["availability"] == INACTIVE
+    assert "1002" not in merged["workers"]
+    # foreign/unknown rule labels are ignored, never minted
+    merged = fleet_alert_states([
+        ("x", _alerts_snap(**{"not_a_rule": FIRING}))])
+    assert set(merged["fleet"]) == set(RULES)
+    assert merged["firing"] == []
+
+
+# ---- gateway surfaces ----
+
+def test_gateway_alerts_endpoint_off_by_default(tmp_path):
+    from chunky_bits_tpu.gateway import make_app
+
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        cluster = make_cluster(tmp_path)
+        async with TestClient(TestServer(make_app(cluster))) as client:
+            resp = await client.get("/alerts")
+            assert resp.status == 200
+            assert await resp.json() == {"enabled": False}
+            stats = await (await client.get("/stats")).json()
+            assert stats["slo"] == {"enabled": False}
+        await cluster.tunables.location_context().aclose()
+
+    asyncio.run(main())
+
+
+def test_gateway_alerts_endpoint_and_build_info(tmp_path):
+    from chunky_bits_tpu import __version__
+    from chunky_bits_tpu.gateway import make_app
+
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        cluster = make_cluster(tmp_path, slo_eval_s=0.05,
+                               slo={"read_p99_ms": 250.0})
+        async with TestClient(TestServer(make_app(cluster))) as client:
+            assert (await client.put("/obj", data=b"z" * 9000)
+                    ).status == 200
+            await (await client.get("/obj")).read()
+            await asyncio.sleep(0.2)  # a few engine ticks
+            alerts = await (await client.get("/alerts")).json()
+            assert alerts["enabled"] is True
+            assert alerts["evaluations"] >= 1
+            assert {a["rule"] for a in alerts["alerts"]} == set(RULES)
+            assert alerts["objectives"]["read_p99_ms"] == 250.0
+            assert alerts["firing"] == []
+            stats = await (await client.get("/stats")).json()
+            assert stats["slo"]["enabled"] is True
+            assert stats["slo"]["evaluations"] >= 1
+            parsed = parse_exposition(
+                await (await client.get("/metrics")).text())
+            for fam in ("cb_alerts_state", "cb_slo_evaluations_total",
+                        "cb_build_info"):
+                assert fam in parsed, f"missing {fam}"
+            # the process-global registry may carry label sets from
+            # other apps built in this process (exactly the
+            # mixed-config fleet view the gauge exists for): find
+            # THIS app's identity row
+            rows = [labels for _n, labels, v
+                    in parsed["cb_build_info"]["samples"] if v == 1]
+            labels = next(r for r in rows if r["slo"] == "on")
+            assert labels["version"] == __version__
+            assert labels["sendfile"] in ("on", "off")
+            assert labels["code"] in ("rs", "pm-msr")
+        await cluster.tunables.location_context().aclose()
+
+    asyncio.run(main())
+
+
+def test_gateway_alerts_fleet_merge_via_spool(tmp_path):
+    """The 2-worker supervisor shape without forking: this worker's
+    live engine plus a sibling's spooled snapshot whose
+    cb_alerts_state says FIRING — /alerts must report the fleet as
+    firing; with the sibling's file reaped, it must not."""
+    from chunky_bits_tpu.gateway import make_app
+
+    spool = tmp_path / "spool"
+    spool.mkdir()
+
+    async def main():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        cluster = make_cluster(tmp_path, slo_eval_s=0.05)
+        app = make_app(cluster, metrics_spool=str(spool))
+        async with TestClient(TestServer(app)) as client:
+            sibling = spool / "worker-9999.json"
+            obs_metrics.write_snapshot_file(
+                str(sibling), _alerts_snap(breaker_open=FIRING))
+            await asyncio.sleep(0.15)
+            alerts = await (await client.get("/alerts")).json()
+            assert alerts["enabled"] is True
+            fleet = alerts["fleet"]
+            assert fleet["fleet"]["breaker_open"] == FIRING
+            assert "breaker_open" in fleet["firing"]
+            assert fleet["workers"]["9999"]["breaker_open"] == FIRING
+            # the supervisor reaps a dead worker's snapshot: its
+            # firing alert must vanish from the very next fleet view
+            os.unlink(sibling)
+            alerts = await (await client.get("/alerts")).json()
+            assert alerts["fleet"]["fleet"]["breaker_open"] == INACTIVE
+            assert "9999" not in alerts["fleet"]["workers"]
+        await cluster.tunables.location_context().aclose()
+
+    asyncio.run(main())
+
+
+# ---- profiler stanza + stats CLI ----
+
+def test_profiler_slo_stanza():
+    from chunky_bits_tpu.file.profiler import new_profiler
+
+    eng = SloEngine(registry=MetricsRegistry())
+    eng.observe({"families": []}, now=0)
+    profiler, reporter = new_profiler()
+    profiler.attach_slo(eng)
+    profiler.attach_slo(eng)  # idempotent
+    report = str(reporter.profile())
+    assert "Slo<evals=1" in report
+    assert report.count("Slo<") == 1
+
+
+def test_stats_cli_renders_alert_stanza(capsys):
+    from chunky_bits_tpu.cli.stats import render_summary
+
+    stats = {"worker": "1", "requests": {}, "dropped": {},
+             "metrics": {"families": []}}
+    out = io.StringIO()
+    render_summary(stats, {"status": "ok"}, {"enabled": False}, out)
+    assert "slo: disabled" in out.getvalue()
+    out = io.StringIO()
+    alerts = {
+        "enabled": True, "evaluations": 42,
+        "firing": ["breaker_open"],
+        "fleet": {"firing": ["breaker_open", "scrub_stall"]},
+        "alerts": [
+            {"rule": "breaker_open", "state": "firing",
+             "value_fast": 0.5, "threshold": 0.3, "fired_count": 1},
+            {"rule": "availability", "state": "pending",
+             "value_fast": 0.02, "threshold": 0.01, "fired_count": 0},
+            {"rule": "scrub_stall", "state": "inactive",
+             "value_fast": None, "threshold": 1.0, "fired_count": 0},
+        ]}
+    render_summary(stats, {"status": "ok"}, {"enabled": False}, out,
+                   alerts=alerts)
+    text = out.getvalue()
+    assert "slo: 1 firing (evals=42) fleet-firing=2" in text
+    lines = [ln for ln in text.splitlines() if "alert " in ln]
+    assert len(lines) == 2, text  # inactive rules stay off-screen
+    assert "firing" in lines[0] and "breaker_open" in lines[0]
+    assert "pending" in lines[1]
+
+
+def test_stats_cli_watch_loops_and_fetches_alerts(tmp_path):
+    """--watch N: the command redraws on the clock-seam cadence; two
+    frames against a live gateway, then cancelled (the CLI's ctrl-c
+    path).  Also pins that the one-shot fetch includes /alerts."""
+    from chunky_bits_tpu.cli.stats import stats_command
+    from chunky_bits_tpu.gateway import make_app
+
+    async def main():
+        from aiohttp.test_utils import TestServer
+
+        cluster = make_cluster(tmp_path, slo_eval_s=0.05)
+        server = TestServer(make_app(cluster))
+        await server.start_server()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            out = io.StringIO()
+            task = asyncio.ensure_future(stats_command(
+                url, as_json=False, out=out, watch_s=0.1))
+            for _ in range(200):
+                await asyncio.sleep(0.05)
+                if out.getvalue().count("--- frame") >= 2:
+                    break
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            text = out.getvalue()
+            assert text.count("--- frame") >= 2
+            assert "slo:" in text
+            # one-shot --json carries the alerts payload
+            out = io.StringIO()
+            assert await stats_command(url, as_json=True,
+                                       out=out) == 0
+            import json as _json
+
+            blob = _json.loads(out.getvalue())
+            assert blob["alerts"]["enabled"] is True
+        finally:
+            await server.close()
+        await cluster.tunables.location_context().aclose()
+
+    asyncio.run(main())
+
+
+# ---- tunables serde ----
+
+def test_tunables_slo_serde_and_env(monkeypatch):
+    from chunky_bits_tpu.cluster.tunables import (SLO_EVAL_S_ENV,
+                                                  Tunables, slo_eval_s)
+    from chunky_bits_tpu.errors import SerdeError
+
+    t = Tunables.from_obj({"slo_eval_s": 15,
+                           "slo": {"breaker_node_fraction": 0.4}})
+    assert t.slo_eval_s == 15.0
+    assert t.to_obj()["slo"] == {"breaker_node_fraction": 0.4}
+    assert Tunables.from_obj(t.to_obj()).slo_eval_s == 15.0
+    # off by default, and off stays out of to_obj
+    assert Tunables.from_obj(None).slo_eval_s == 0.0
+    assert "slo_eval_s" not in Tunables.from_obj(None).to_obj()
+    with pytest.raises(SerdeError, match="slo_eval_s"):
+        Tunables.from_obj({"slo_eval_s": -1})
+    with pytest.raises(SerdeError, match="unknown slo objective"):
+        Tunables.from_obj({"slo": {"tpyo": 3}})
+    monkeypatch.setenv(SLO_EVAL_S_ENV, "30")
+    assert slo_eval_s() == 30.0
+    monkeypatch.setenv(SLO_EVAL_S_ENV, "garbage")
+    assert slo_eval_s() == 0.0  # lenient: a perf knob can only tune
