@@ -2701,6 +2701,149 @@ def _slo_overhead_ab(reads: int) -> dict:
     return asyncio.run(run())
 
 
+def bench_crash_matrix(argv=()) -> None:
+    """BASELINE.md config 16: the crash-consistency matrix suite
+    (CPU-only, no device, no watchdog).
+
+    Three legs, all asserted in-run (chunky_bits_tpu/sim/crash.py):
+
+    1. **Matrix** — every storage-plane mutation (slab append +
+       journal commit, GC mark-dead, compaction, atomic chunk
+       publication, metadata publication, the repair planner's
+       in-place rewrite) is recorded through the filesystem seam
+       (file/fsio.py), then EVERY prefix "crash at op k" is replayed
+       into a cloned directory under the kill / flush / torn-write /
+       power-cut (per-file writeback masks) / power-cut-with-lost-
+       renames failure models, and a cold restart is verified against
+       the recovery invariants: durable data byte-exact, the mutated
+       name absent|exact|detectably-damaged (powercut only),
+       compaction leaves old or new journal (never neither),
+       acknowledged metadata publications survive every model, the
+       stale-temp reaper never eats a live file, and the store
+       accepts new work.  ANY red image fails the run.
+    2. **Scrub recovery** — a real erasure-coded cluster (five
+       ``slab:`` destinations) ingests an object while one
+       destination records; selected crash images of that node —
+       including the journal-line-without-slab-bytes power-cut image
+       slab.py documents — are spliced back and ``scrub --once``
+       (production daemon + repair planner) must converge the
+       namespace to Valid with byte-identical reads.
+    3. **Determinism** — the whole matrix re-run with the same seed
+       must produce the identical normalized op-stream + verdict
+       digest.
+
+    Flags: ``--seed N`` (default 0), ``--mutations a,b,...`` (default:
+    the whole library), ``--smoke`` (CI-scale: three mutations, the
+    power-cut scrub image only).
+
+    Failure contract (tests/test_bench_outage.py): ANY failure still
+    emits exactly one parseable JSON line and exits 3."""
+    import tempfile
+    import time as _time
+
+    argv = list(argv)
+
+    def flag(name, default, cast):
+        if name in argv:
+            return cast(argv[argv.index(name) + 1])
+        return default
+
+    metric = "crash_matrix_images"
+    try:
+        seed = flag("--seed", 0, int)
+        picked = flag("--mutations", "", str)
+        smoke = "--smoke" in argv
+
+        from chunky_bits_tpu.sim import crash
+
+        if smoke:
+            names = ["slab_append", "slab_compact", "metadata_publish"]
+            points = "smoke"
+        else:
+            names = sorted(crash.MUTATIONS)
+            points = "full"
+        if picked:
+            names = [n.strip() for n in picked.split(",") if n.strip()]
+        unknown = [n for n in names if n not in crash.MUTATIONS]
+        if unknown:
+            raise ValueError(f"unknown mutation(s) {unknown} "
+                             f"(know {sorted(crash.MUTATIONS)})")
+
+        t0 = _time.monotonic()
+        with tempfile.TemporaryDirectory(prefix="cb_crash16_") as tmp:
+            result = crash.run_matrix(f"{tmp}/m1", seed=seed,
+                                      mutations=names)
+            if not result.ok():
+                raise AssertionError(
+                    "crash images failed recovery: "
+                    f"{[v.to_obj() for v in result.failed()[:6]]}")
+            for row in result.rows():
+                print(f"# config 16: {row['mutation']}: "
+                      f"{row['ops']} ops, {row['images']} images, "
+                      f"all recovered", file=sys.stderr)
+            cluster_verdicts = crash.run_cluster_recovery(
+                f"{tmp}/cluster", seed=seed, points=points)
+            cluster_failed = [v for v in cluster_verdicts if not v.ok]
+            if cluster_failed:
+                raise AssertionError(
+                    "scrub --once failed to converge crash images: "
+                    f"{[v.to_obj() for v in cluster_failed[:6]]}")
+            print(f"# config 16: scrub recovery: "
+                  f"{len(cluster_verdicts)} cluster images (incl. the "
+                  f"journal-line-without-slab-bytes power cut) all "
+                  f"converged to Valid", file=sys.stderr)
+            second = crash.run_matrix(f"{tmp}/m2", seed=seed,
+                                      mutations=names)
+            deterministic = (second.digest == result.digest
+                             and second.ok())
+            if not deterministic:
+                raise AssertionError(
+                    "crash matrix determinism violated: same seed "
+                    f"produced digest {second.digest[:16]} vs "
+                    f"{result.digest[:16]}")
+        wall = _time.monotonic() - t0
+
+        images = len(result.verdicts)
+        images_ok = sum(1 for v in result.verdicts if v.ok)
+        cluster_ok = sum(1 for v in cluster_verdicts if v.ok)
+        print(f"# config 16: {len(names)} mutations, "
+              f"{result.crash_points()} crash points, {images} images "
+              f"+ {len(cluster_verdicts)} cluster images, all "
+              f"recovered, deterministic, {wall:.1f}s wall",
+              file=sys.stderr)
+        print(json.dumps({
+            "metric": metric,
+            # the headline: how many distinct crash images were
+            # verified invariant-clean this run
+            "value": images_ok + cluster_ok, "unit": "images",
+            # acceptance floor: every enumerated image recovers —
+            # ratio of verified-clean to enumerated (must be 1.0)
+            "vs_baseline": round(
+                (images_ok + cluster_ok)
+                / max(images + len(cluster_verdicts), 1), 3),
+            "seed": seed,
+            "mutations": len(names),
+            "crash_points": result.crash_points(),
+            "images": images,
+            "images_ok": images_ok,
+            "cluster_images": len(cluster_verdicts),
+            "cluster_images_ok": cluster_ok,
+            "deterministic": deterministic,
+            "digest": result.digest,
+            "wall_s": round(wall, 2),
+            "rows": result.rows(),
+        }))
+    # lint: broad-except-ok the driver contract (ONE parseable JSON
+    # line, always) outranks the traceback; the error text carries it
+    except Exception as err:
+        print(json.dumps({
+            "metric": metric, "value": 0.0, "unit": "images",
+            "vs_baseline": 0.0,
+            "error": f"{type(err).__name__}: {err}"[:2000],
+        }))
+        sys.exit(3)
+
+
 def bench_xor_schedule(argv=()) -> None:
     """BASELINE.md config 12: scheduled-XOR erasure engine vs the
     byte-table kernels (CPU-only, no tunnel, no gateway).
@@ -2898,12 +3041,13 @@ if __name__ == "__main__":
                    "12": lambda: bench_xor_schedule(sys.argv),
                    "13": lambda: bench_pm_msr_repair(sys.argv),
                    "14": lambda: bench_sim_scenarios(sys.argv),
-                   "15": lambda: bench_slo_detection(sys.argv)}
+                   "15": lambda: bench_slo_detection(sys.argv),
+                   "16": lambda: bench_crash_matrix(sys.argv)}
         idx = sys.argv.index("--config") + 1
         which = sys.argv[idx] if idx < len(sys.argv) else ""
         if which not in configs:
             print(f"usage: bench.py [--config "
-                  f"{{1,2,3,4,6,7,8,9,10,11,12,13,14,15}}]"
+                  f"{{1,2,3,4,6,7,8,9,10,11,12,13,14,15,16}}]"
                   f" — the device kernel metric (configs 2+3's compute "
                   f"core) is the default no-arg run (got {which!r}); 6 "
                   f"is the hot-read cache A/B, 7 the gateway PUT ingest "
@@ -2914,8 +3058,8 @@ if __name__ == "__main__":
                   f"erasure engine vs byte-table grid, 13 the pm-msr "
                   f"regenerating-code vs rs repair-bandwidth A/B, 14 "
                   f"the simulator scenario-suite runner, 15 the SLO "
-                  f"detection-quality + engine-off overhead suite "
-                  f"(all CPU-only)",
+                  f"detection-quality + engine-off overhead suite, 16 "
+                  f"the crash-consistency matrix suite (all CPU-only)",
                   file=sys.stderr)
             sys.exit(2)
         configs[which]()

@@ -10,7 +10,7 @@ review when either changes.
 from __future__ import annotations
 
 import ast
-from typing import Iterator
+from typing import Iterator, Optional
 
 #: path prefixes of the device/network call paths — the routes where an
 #: unbounded wait or a non-daemon worker can hang a serve or block exit
@@ -572,12 +572,83 @@ class ClockSeamRule(Rule):
                        "with `# lint: clock-ok <reason>`")
 
 
+class FsioSeamRule(Rule):
+    """CB109 — the filesystem seam cannot silently rot.
+
+    Every durability-relevant op on the storage plane — slab append +
+    journal commit, compaction swap, atomic chunk/metadata
+    publication, the repair planner's in-place rewrites — resolves
+    through ``file/fsio.py`` (the seam ``chunky_bits_tpu/utils/fsio.py``
+    implements), so the crash-consistency harness
+    (``chunky_bits_tpu/sim/crash.py``) can record the exact op stream
+    of a mutation and replay every "crash at op k" prefix into a
+    cloned directory.  A direct ``os.replace``/``os.fsync``/
+    ``os.unlink``/write-mode ``open`` (and friends) in the storage
+    modules would mutate disk state INVISIBLY to the recorder — the
+    crash matrix would go green while skipping the very op that tears.
+    Deliberate off-seam sites (read-side probes, lock files) carry
+    ``# lint: fsio-ok <reason>``; the seam modules themselves are the
+    sanctioned homes for direct calls.
+    """
+
+    id = "CB109"
+    slug = "fsio"
+    description = ("storage-plane durability ops go through the "
+                   "file/fsio.py seam")
+    paths = ("file/slab.py", "file/location.py", "cluster/metadata.py",
+             "cluster/repair.py", "cluster/scrub.py")
+
+    #: the os-level durability verbs the seam wraps (os.rename rides
+    #: along: it is os.replace minus the overwrite guarantee)
+    OS_VERBS = ("replace", "rename", "fsync", "unlink", "remove",
+                "truncate", "ftruncate", "makedirs", "mkdir", "rmdir",
+                "open", "write")
+
+    def _mode_of(self, node: ast.Call) -> str:
+        """The literal mode argument of a builtin-open call, or ''."""
+        mode_node: Optional[ast.AST] = None
+        if len(node.args) >= 2:
+            mode_node = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode_node = kw.value
+        if isinstance(mode_node, ast.Constant) \
+                and isinstance(mode_node.value, str):
+            return mode_node.value
+        return ""
+
+    def check(self, sf) -> Iterator[Finding]:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if chain.startswith("os."):
+                verb = chain[3:].split(".", 1)[0]
+                if verb in self.OS_VERBS:
+                    yield (node.lineno, node.col_offset,
+                           f"direct {chain}() bypasses the filesystem "
+                           "seam — the crash harness cannot record or "
+                           "replay this mutation; route through "
+                           "file/fsio.py (fsio.replace/fsio.fsync/"
+                           "fsio.open/...) or justify with "
+                           "`# lint: fsio-ok <reason>`")
+            elif chain == "open":
+                mode = self._mode_of(node)
+                if any(c in mode for c in "wax+"):
+                    yield (node.lineno, node.col_offset,
+                           f"write-mode open({mode!r}) bypasses the "
+                           "filesystem seam — the crash harness cannot "
+                           "record or replay this mutation; use "
+                           "fsio.open or justify with "
+                           "`# lint: fsio-ok <reason>`")
+
+
 #: one-line hazard descriptions for --list-rules family grouping
 FAMILY_HAZARDS = {
     "CB1xx": ("single-function invariants: bounded waits, env-flag "
               "discipline, daemon threads, narrow excepts, jit "
               "hygiene, typing floor, metric label cardinality, "
-              "clock-seam discipline"),
+              "clock-seam discipline, filesystem-seam discipline"),
     "CB2xx": ("concurrency hazards of the two-plane host/async "
               "runtime: blocked loops, cross-plane handoffs, leaked "
               "tasks, loop-spanning shared state"),
@@ -597,4 +668,5 @@ ALL_RULES: tuple[Rule, ...] = (
     PublicAnnotationsRule(),
     MetricLabelCardinalityRule(),
     ClockSeamRule(),
+    FsioSeamRule(),
 ) + CONCURRENCY_RULES

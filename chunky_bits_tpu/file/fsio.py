@@ -1,0 +1,64 @@
+"""The storage plane's filesystem seam (canonical surface).
+
+``FsProvider`` / ``RecordingFsProvider`` / ``FaultyFsProvider`` /
+``open`` / ``replace`` / ``unlink`` / ``truncate`` / ``makedirs`` /
+``fsync`` / ``fsync_dir`` / ``install`` / ``active`` — every
+durability-relevant filesystem op on the storage plane (slab append +
+journal commit, compaction, atomic chunk/metadata publication, the
+repair planner's in-place rewrites) resolves through this seam so the
+crash-consistency harness (``chunky_bits_tpu/sim/crash.py``) can swap
+in a recording provider, replay every "crash at op k" prefix into a
+cloned directory, and prove the recovery invariants the docstrings
+claim.  Lint rule CB109 (analysis/rules.py) pins the discipline:
+direct ``os.replace``/``os.fsync``/``os.unlink``/write-mode ``open``
+(and friends) in ``file/slab.py``, ``file/location.py``,
+``cluster/metadata.py``, ``cluster/repair.py`` and
+``cluster/scrub.py`` are flagged unless they carry a
+``# lint: fsio-ok <reason>`` justification.
+
+The implementation lives in ``chunky_bits_tpu/utils/fsio.py`` and is
+re-exported here whole, exactly like the clock seam
+(``cluster/clock.py`` re-exporting ``utils/clock.py``): ``file/``
+modules must be importable without package-``__init__`` cycles, so
+they import the utils side directly while ``cluster/`` modules import
+this canonical surface.  Both names are the same module-level state:
+``install`` through either rebinds the one active provider.
+"""
+
+from __future__ import annotations
+
+#: re-exported whole — see the module docstring for why the
+#: implementation lives on the utils side of the package graph
+from chunky_bits_tpu.utils.fsio import (  # noqa: F401
+    FaultyFsProvider,
+    FsOp,
+    FsProvider,
+    RecordingFsProvider,
+    active,
+    fsync,
+    fsync_dir,
+    install,
+    makedirs,
+    open,
+    replace,
+    system_provider,
+    truncate,
+    unlink,
+)
+
+__all__ = [
+    "FaultyFsProvider",
+    "FsOp",
+    "FsProvider",
+    "RecordingFsProvider",
+    "active",
+    "fsync",
+    "fsync_dir",
+    "install",
+    "makedirs",
+    "open",
+    "replace",
+    "system_provider",
+    "truncate",
+    "unlink",
+]

@@ -31,6 +31,7 @@ from urllib.parse import quote, urlsplit, urlunsplit
 #: and profiler see comes off this clock, so the simulator's virtual
 #: timebase flows through unchanged.
 from chunky_bits_tpu.utils import clock as _clock
+from chunky_bits_tpu.utils import fsio as _fsio
 
 from chunky_bits_tpu.errors import (
     HttpStatusError,
@@ -240,7 +241,16 @@ async def _publish_atomically(target: str, write_body) -> int:
     follows the filesystem's rename semantics (flush, no fsync —
     matching the reference's flush-only behavior): after power loss the
     path holds the old content, the new content, or on some filesystems
-    an empty file, but never a torn mix.  Direct writes are kept for
+    an empty file, but never a torn mix.  This is machine-verified, not
+    argued: the ops ride the filesystem seam (``file/fsio.py``) and the
+    crash harness (sim/crash.py ``chunk_publish``/``repair_rewrite``,
+    bench ``--config 16``) replays every crash point of this protocol —
+    kill, torn temp write, power-cut writeback orders — asserting the
+    published path is only ever old | new | content-address-detectable,
+    and that crashed writers' temps stay reapable without touching it.
+    (Chunk publication stays flush-only by design; metadata publication,
+    the cluster's write acknowledgment, adds the fsync+dir-fsync
+    barriers — cluster/metadata.py.)  Direct writes are kept for
     symlinks (write through, preserving the link), special targets
     (devices, fifos — rename would replace the node), and as a fallback
     when the parent directory refuses temp creation (EACCES/EPERM/EROFS
@@ -273,7 +283,7 @@ async def _publish_atomically(target: str, write_body) -> int:
         # lint: async-blocking-ok bounded local rename; a suspension
         # here would let a cancellation race the reap against the
         # in-flight swap (see docstring)
-        os.replace(tmp, target)
+        _fsio.replace(tmp, target)
         return total
     except OSError as err:
         created = _reap_publish_temp(tmp)
@@ -304,7 +314,7 @@ def _reap_publish_temp(tmp: str) -> bool:
     far enough to create it — the EACCES-fallback discriminator)."""
     created = os.path.exists(tmp)
     try:
-        os.unlink(tmp)
+        _fsio.unlink(tmp)
     except OSError:
         pass
     return created
@@ -312,7 +322,7 @@ def _reap_publish_temp(tmp: str) -> bool:
 
 async def _atomic_publish(target: str, data) -> None:
     def _write(path: str) -> int:
-        with open(path, "wb") as f:
+        with _fsio.open(path, "wb") as f:
             f.write(data)
             f.flush()
         return len(data)
@@ -1058,7 +1068,7 @@ class Location:
             await node.delete(name)
         elif self.is_local():
             try:
-                await asyncio.to_thread(os.remove, self.target)
+                await asyncio.to_thread(_fsio.unlink, self.target)
             except OSError as err:
                 raise LocationError(str(err)) from err
         else:

@@ -33,8 +33,20 @@ rename publication accepts, except here it is content-addressed and
 therefore *detectable*: every read verifies against the golden digest
 and falls through/reconstructs, and the scrub daemon
 (cluster/scrub.py) finds and repairs such extents without waiting for
-a client read.  (``compact()`` DOES fsync before its journal swap —
-one fsync per compaction is cheap; one per chunk append is not.)
+a client read.  This window is no longer prose: every durability op
+here rides the filesystem seam (``file/fsio.py``), and the
+crash-consistency harness (``chunky_bits_tpu/sim/crash.py``, bench
+``--config 16``) replays every crash point of the append/commit/
+compact protocols — including exactly this journal-line-without-
+slab-bytes power-cut image — and proves a cold restart recovers and
+``scrub --once`` converges the namespace to Valid
+(tests/test_crash.py).  (``compact()`` DOES fsync its data and the
+store directory around its journal swap — one fsync per compaction is
+cheap and makes the swap an *acknowledged*, power-loss-durable
+publication; one per chunk append is not, which is the measured
+tradeoff above.)  A short append (ENOSPC mid-write) truncates its
+partial tail back off the slab before surfacing, so offset accounting
+never packs around garbage.
 Deletion appends ``{"o": "d", "n": <name>}``: the extent goes *dead*
 and its bytes are reclaimed by :meth:`SlabStore.compact`, never by
 punching the slab file (GC of a packed chunk must not serialize on
@@ -69,6 +81,8 @@ import re
 import threading
 import time
 from typing import Iterator, NamedTuple, Optional
+
+from chunky_bits_tpu.utils import fsio as _fsio
 
 #: rollover threshold for the active slab file; a few hundred MiB keeps
 #: per-slab mmap windows and compaction copies bounded while still
@@ -116,6 +130,9 @@ class _Flock:
     def __enter__(self) -> "_Flock":
         import fcntl
 
+        # lint: fsio-ok the flock target carries no data — creating it
+        # is idempotent and crash-indifferent, so the harness has
+        # nothing to record or replay here
         self._fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o644)
         try:
             fcntl.flock(self._fd, fcntl.LOCK_EX)
@@ -340,21 +357,28 @@ class SlabStore:
 
     def _journal_append_locked(self, record: dict) -> None:
         line = (json.dumps(record, separators=(",", ":")) + "\n").encode()
-        # O_RDWR, not O_WRONLY: the torn-tail probe preads the last byte
-        fd = os.open(self.journal_path(),
-                     os.O_CREAT | os.O_RDWR | os.O_APPEND, 0o644)
-        try:
-            size = os.fstat(fd).st_size
-            if size > 0 and os.pread(fd, 1, size - 1) != b"\n":
-                # a crashed writer left a torn final line: terminate it
-                # first so this record starts a fresh line instead of
-                # merging into (and dying with) the fragment
-                line = b"\n" + line
-            os.write(fd, line)
+        # 'a+b', not write-only: the torn-tail probe reads the last
+        # byte through the same append handle (O_APPEND keeps every
+        # write at EOF regardless of the probe's seek).  Unbuffered:
+        # the probe must read exactly ONE byte (a buffered handle
+        # would drag a full block through the filesystem per append —
+        # measured 9p regression), and the line must land in one
+        # write syscall like the os.write it replaces.  Seam-routed so
+        # the crash harness records the commit (sim/crash.py replays a
+        # crash at every point of this sequence).
+        with _fsio.open(self.journal_path(), "a+b", buffering=0) as f:
+            size = os.fstat(f.fileno()).st_size
+            if size > 0:
+                f.seek(size - 1)
+                if f.read(1) != b"\n":
+                    # a crashed writer left a torn final line:
+                    # terminate it first so this record starts a fresh
+                    # line instead of merging into (and dying with)
+                    # the fragment
+                    line = b"\n" + line
+            f.write(line)
             if self._journal_id is None:
-                self._journal_id = os.fstat(fd).st_ino
-        finally:
-            os.close(fd)
+                self._journal_id = os.fstat(f.fileno()).st_ino
         # the caller applies this record in-memory; everything between
         # the last refresh position and the pre-append size was at most
         # the torn fragment just terminated (refresh consumed every
@@ -371,18 +395,40 @@ class SlabStore:
         if "/" in name or name in (".", "..", ""):
             raise SlabStoreError(f"invalid slab chunk name {name!r}")
         view = memoryview(data)
-        os.makedirs(self.root, exist_ok=True)
+        _fsio.makedirs(self.root)
         with self._lock, _Flock(self.root):
             self._refresh_locked()
             slab, offset = self._active_slab_locked(len(view))
-            with open(self.slab_path(slab), "ab") as f:
+            path = self.slab_path(slab)
+            with _fsio.open(path, "ab") as f:
                 # 'ab' positions at EOF; trust the fd, not the earlier
                 # stat (another writer under a different root handle
                 # could have raced the rollover decision, never the
                 # bytes — appends are flock-serialized)
                 offset = f.tell()
-                f.write(view)
-                f.flush()
+                try:
+                    f.write(view)
+                    f.flush()
+                except OSError:
+                    # ENOSPC/EIO mid-append: a short write left a
+                    # partial tail past `offset`.  Close (a retried
+                    # flush may fail again — the bytes are already
+                    # doomed) and truncate the tail away so the next
+                    # append's offset accounting never packs around
+                    # garbage; nothing was journaled, so the failed
+                    # append is invisible to every reader
+                    # (tests/test_crash.py pins this with injected
+                    # short writes)
+                    try:
+                        f.close()
+                    except OSError:
+                        pass
+                    try:
+                        _fsio.truncate(path, offset)
+                    except OSError:
+                        pass  # reclaim is best-effort: the tail is
+                        # unreferenced either way, just unreclaimed
+                    raise
             # lint: clock-ok wall-clock publish stamp for humans (the
             # journal's `t` field is operator forensics, never a
             # duration — it must stay real even inside a simulation)
@@ -415,12 +461,18 @@ class SlabStore:
 
     def compact(self) -> dict:
         """Reclaim dead extents: copy every live extent into fresh slab
-        files, atomically swap in a rewritten journal, unlink the old
-        slabs.  The copy-then-publish shape of the CLI's ``migrate``:
-        data lands first, the single rename makes it authoritative,
-        and a crash at any point leaves a store that reads either
-        entirely pre- or entirely post-compaction.  Returns
-        ``{"copied_bytes", "reclaimed_bytes", "live_chunks"}``."""
+        files, atomically swap in a rewritten journal (data fsync'd
+        before the rename, the store directory fsync'd after it),
+        unlink the old slabs.  The copy-then-publish shape of the
+        CLI's ``migrate``: data lands first, the single rename makes
+        it authoritative, and a crash at any point leaves a store that
+        reads either entirely pre- or entirely post-compaction — the
+        crash harness replays every point of this sequence under
+        kill/torn/power-cut models and verifies exactly that
+        (sim/crash.py ``slab_compact``, tests/test_crash.py).  A
+        failing fsync aborts the swap loudly before the in-memory
+        state flips.  Returns ``{"copied_bytes", "reclaimed_bytes",
+        "live_chunks"}``."""
         with self._lock, _Flock(self.root):
             self._refresh_locked()
             old_slabs = self.slab_files()
@@ -431,18 +483,17 @@ class SlabStore:
             out_path = self.slab_path(out_slab)
             new_live: dict[str, SlabExtent] = {}
             lines: list[str] = []
-            out = open(out_path, "wb")
+            out = _fsio.open(out_path, "wb")
             try:
                 for name, ext in sorted(self._live.items()):
                     if out.tell() + ext.length > self.slab_max_bytes \
                             and out.tell() > 0:
-                        out.flush()
-                        os.fsync(out.fileno())
+                        _fsio.fsync(out)
                         out.close()
                         base += 1
                         out_slab = _slab_name(base)
                         out_path = self.slab_path(out_slab)
-                        out = open(out_path, "wb")
+                        out = _fsio.open(out_path, "wb")
                     offset = out.tell()
                     with open(self.slab_path(ext.slab), "rb") as src:
                         src.seek(ext.offset)
@@ -464,24 +515,37 @@ class SlabStore:
                          "f": offset, "l": ext.length,
                          "t": ext.published},
                         separators=(",", ":")))
-                out.flush()
-                os.fsync(out.fileno())
+                # a failing fsync here (or on the journal temp below)
+                # propagates and ABORTS the swap: the old journal stays
+                # authoritative, nothing is published against bytes
+                # that may never have reached the platter
+                # (failed-fsync poisoning — tests/test_crash.py
+                # scripts it through the seam)
+                _fsio.fsync(out)
             finally:
                 out.close()
             if not new_live:
                 # nothing live: the fresh slab is empty — drop it
                 # rather than leave a zero-byte rollover target
                 try:
-                    os.unlink(out_path)
+                    _fsio.unlink(out_path)
                 except OSError:
                     pass
             payload = ("".join(line + "\n" for line in lines)).encode()
             tmp = self.journal_path() + f".compact.{os.getpid()}"
-            with open(tmp, "wb") as f:
+            with _fsio.open(tmp, "wb") as f:
                 f.write(payload)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.journal_path())
+                _fsio.fsync(f)
+            _fsio.replace(tmp, self.journal_path())
+            # directory-entry barrier: without it the completed rename
+            # is not power-loss durable — a post-compaction power cut
+            # could resurrect the old journal while later appends
+            # landed against the new one (the acknowledged-write
+            # durability gap the crash harness exposes; sim/crash.py's
+            # powercut-meta images pin both directions).  A failure
+            # raises BEFORE the in-memory state flips, so the store
+            # re-reads whichever journal the disk actually holds.
+            _fsio.fsync_dir(self.root)
             reclaimed = self._dead_bytes
             self._live = new_live
             self._dead_bytes = 0
@@ -491,7 +555,7 @@ class SlabStore:
             for slab in old_slabs:
                 if slab not in keep:
                     try:
-                        os.unlink(self.slab_path(slab))
+                        _fsio.unlink(self.slab_path(slab))
                     except OSError:
                         pass  # still mapped elsewhere is fine; orphaned
             return {"copied_bytes": copied,

@@ -118,13 +118,21 @@ class FaultInjector:
     * ``fail_puts``          — every write answers 507 (broken disk).
     * ``put_fail_status``/``put_fail_remaining`` — the next N writes
       answer with this status, then normal service resumes (the
-      transient-retry script)."""
+      transient-retry script).
+    * ``torn_put_bytes``/``torn_put_remaining`` — the next N writes
+      are ACKED but persist only a prefix of the payload: the silent
+      torn write the crash harness (``sim/crash.py``) enumerates at
+      syscall scale, scriptable here at fleet scale.  Only the
+      content-address gate can catch it afterwards — the disk-fault
+      axis of scenario scripting (``disk_corruption_storm``)."""
 
     def __init__(self, fail_puts: bool = False) -> None:
         self.get_delay = 0.0
         self.fail_puts = fail_puts
         self.put_fail_status = 0
         self.put_fail_remaining = 0
+        self.torn_put_bytes = 0
+        self.torn_put_remaining = 0
 
     def get_fault(self) -> float:
         """Seconds a read must stall before being served."""
@@ -139,6 +147,17 @@ class FaultInjector:
         if self.fail_puts:
             return 507
         return 0
+
+    def torn_fault(self, nbytes: int) -> Optional[int]:
+        """Bytes the next write silently keeps (None = write whole).
+        One-shot budget, like ``put_fault`` — consumed only when the
+        write actually tears (a payload already shorter than the torn
+        prefix cannot tear, and must not burn the budget)."""
+        if self.torn_put_remaining > 0 and 0 < self.torn_put_bytes \
+                < nbytes:
+            self.torn_put_remaining -= 1
+            return self.torn_put_bytes
+        return None
 
 
 class SimNode:
@@ -175,6 +194,7 @@ class SimNode:
         self.bytes_read = 0
         self.bytes_written = 0
         self.errors_injected = 0
+        self.torn_writes = 0
 
     # ---- state machine ----
 
@@ -260,6 +280,17 @@ class SimNode:
 
     async def write(self, name: str, data: bytes) -> None:
         await self._serve("put", len(data))
+        torn = self.faults.torn_fault(len(data))
+        if torn is not None:
+            # silent torn write: the node ACKS the put but persists
+            # only a prefix — detectable later solely by the
+            # content-address gate (scrub's next pass re-reads,
+            # mismatches, and repairs again)
+            self.store[name] = bytes(data[:torn])
+            self._bump(bytes_written=torn, torn_writes=1)
+            self.fabric.trace("torn_write", node=self.node_id,
+                              chunk=name, kept=torn, total=len(data))
+            return
         self.store[name] = bytes(data)
         self._bump(bytes_written=len(data))
 
@@ -309,6 +340,7 @@ class SimNode:
                 "bytes_read": self.bytes_read,
                 "bytes_written": self.bytes_written,
                 "errors_injected": self.errors_injected,
+                "torn_writes": self.torn_writes,
             }
 
 

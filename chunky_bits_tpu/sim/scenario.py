@@ -917,6 +917,74 @@ async def _slow_leak(env: ScenarioEnv) -> None:
     env.check_repair_bytes()
 
 
+async def _disk_corruption_storm(env: ScenarioEnv) -> None:
+    """The disk-fault axis (PR-14's crash harness at fleet scale): a
+    burst of latent corruption lands across many nodes in one scrub
+    interval — a bad firmware push, not a single rotting sector —
+    while one victim node silently TEARS its next repair writes (acks
+    a prefix: the crash harness's torn-write image as a live fleet
+    fault) and another refuses writes disk-full for a while.  Scrub
+    must detect every rotten replica through the content-address gate,
+    repair must ride out torn and refused rewrites (re-detect, retry
+    next pass — a torn repair is corruption again, never silent
+    success), reads stay byte-identical throughout (reconstruction
+    covers every window), and the namespace converges to Valid.  No
+    fault window is declared: nothing here may ever be client-visible,
+    and the SLO engine must stay silent (precision check)."""
+    fab = env.fabric
+    env.start_scrub()
+    env.start_client(period_s=4.0)
+    await env.sleep(90.0)
+    names = sorted(env.contents)
+    victims = names[:8]
+    # the torn-writes node: holder of victims[0] part-0 chunk-0, which
+    # we corrupt deliberately so its repair write is the one that tears
+    locs = await env._locations_of(victims[0])
+    torn_target = [t for pi, ci, t in locs if pi == 0 and ci == 0][0]
+    torn_node, _ = fabric_mod.resolve(torn_target)
+    torn_node.faults.torn_put_bytes = 64
+    torn_node.faults.torn_put_remaining = 2
+    # the disk-full node: holder of victims[1] part-0 chunk-1
+    locs = await env._locations_of(victims[1])
+    full_target = [t for pi, ci, t in locs if pi == 0 and ci == 1][0]
+    full_node, _ = fabric_mod.resolve(full_target)
+    full_node.faults.put_fail_status = 507
+    full_node.faults.put_fail_remaining = 3
+    env.event("corruption_storm_begin", victims=len(victims),
+              torn_node=torn_node.node_id,
+              full_node=full_node.node_id)
+    burst = 0
+    for i, name in enumerate(victims):
+        chunk = (0 if i == 0 else
+                 1 if i == 1 else env.rand.randrange(env.d))
+        if await env.corrupt_replica(name, part=0, chunk=chunk):
+            burst += 1
+    env.event("corruption_storm_landed", corrupted=burst)
+    # several scrub intervals: detect, repair, re-detect the torn
+    # repairs, exhaust the fault budgets, repair for good
+    await env.sleep(env.scrub_interval_s * 8)
+    await env.stop_client()
+    converged = await env.wait_converged(1800.0)
+    stats = env.scrub_stats()
+    await env.stop_scrub()
+    env.verdict("converged", converged)
+    env.verdict("corruption_detected", stats.corrupt >= burst,
+                corrupt=stats.corrupt, burst=burst,
+                repaired=stats.repaired)
+    # the scripted disk faults must actually have fired (a vacuously
+    # green storm proves nothing)
+    env.verdict("torn_writes_ridden_out",
+                torn_node.torn_writes >= 1
+                and torn_node.faults.torn_put_remaining == 0,
+                torn_writes=torn_node.torn_writes)
+    env.verdict("disk_full_ridden_out",
+                full_node.faults.put_fail_remaining == 0,
+                errors_injected=full_node.errors_injected)
+    # corruption is exactly what parity exists for: never client-visible
+    env.check_reads_clean()
+    env.check_repair_bytes()
+
+
 async def _fleet_partition(env: ScenarioEnv) -> None:
     """Total connectivity loss: every zone partitions away while the
     continuous scrub runs.  The chunk bytes are all intact — the only
@@ -1031,6 +1099,13 @@ SCENARIOS: dict[str, Scenario] = {
         # never stalls, no storms — silence is the correct verdict
         Scenario("slow_leak", _slow_leak, {
             "scrub_bytes_per_sec": 50e6, "scrub_interval_s": 45.0,
+        }),
+        # the disk-fault axis: a corruption burst plus torn and
+        # refused repair writes — all absorbed by scrub/repair, never
+        # client-visible, SLO engine silent (precision check)
+        Scenario("disk_corruption_storm", _disk_corruption_storm, {
+            "scrub_bytes_per_sec": 50e6, "scrub_interval_s": 45.0,
+            "objects": 12,
         }),
         # total connectivity loss: scrub-progress stall, fleet-wide
         # breaker degradation, AND the planner's fallback storm (every

@@ -14,6 +14,8 @@ import io
 import os
 from typing import AsyncIterator, Optional, Protocol, runtime_checkable
 
+from chunky_bits_tpu.utils import fsio as _fsio
+
 
 def mmap_opted_out() -> bool:
     """True when ``CHUNKY_BITS_TPU_NO_MMAP`` is set to a truthy value
@@ -366,7 +368,9 @@ async def copy_reader_to_file(reader: AsyncByteReader, path: str,
     io_copy overlap, src/bin/chunky-bits/util.rs:14-59, without the
     unsafe 'static transmutes).  Returns bytes copied."""
     total = 0
-    f = await asyncio.to_thread(open, path, "wb")
+    # seam-routed open: streaming chunk publication must be recordable
+    # by the crash harness just like the whole-buffer path
+    f = await asyncio.to_thread(_fsio.open, path, "wb")
     pending: Optional[asyncio.Task] = None
     try:
         while True:

@@ -416,6 +416,81 @@ def test_clock_rule_passes_seam_reads(tmp_path):
     """, select=("CB108",)) == []
 
 
+# ---- CB109 fsio-seam ----
+
+def test_fsio_rule_flags_direct_os_verbs_in_scope(tmp_path):
+    vs = run_snippet(tmp_path, "file/slab.py", """
+        import os
+
+        def swap(tmp, target, root):
+            os.replace(tmp, target)
+            os.fsync(3)
+            os.unlink(tmp)
+    """, select=("CB109",))
+    assert [v.rule for v in vs] == ["CB109", "CB109", "CB109"]
+    assert "filesystem seam" in vs[0].message
+
+
+def test_fsio_rule_flags_write_mode_open_only(tmp_path):
+    vs = run_snippet(tmp_path, "cluster/metadata.py", """
+        def publish(path, data):
+            with open(path, "wb") as f:
+                f.write(data)
+
+        def probe(path):
+            with open(path, "rb") as f:
+                return f.read(1)
+
+        def default_mode_read(path):
+            with open(path) as f:
+                return f.read()
+    """, select=("CB109",))
+    assert [v.rule for v in vs] == ["CB109"]
+    assert "write-mode open" in vs[0].message
+
+
+def test_fsio_rule_out_of_scope_modules_pass(tmp_path):
+    # the seam applies to the storage-plane modules, not the whole tree
+    assert run_snippet(tmp_path, "gateway/http.py", """
+        import os
+
+        def f(a, b):
+            os.replace(a, b)
+    """, select=("CB109",)) == []
+
+
+def test_fsio_rule_passes_seam_calls_and_suppressions(tmp_path):
+    assert run_snippet(tmp_path, "file/location.py", """
+        from chunky_bits_tpu.utils import fsio as _fsio
+
+        def publish(tmp, target):
+            with _fsio.open(tmp, "wb") as f:
+                f.write(b"x")
+                _fsio.fsync(f)
+            _fsio.replace(tmp, target)
+    """, select=("CB109",)) == []
+    assert run_snippet(tmp_path, "file/slab.py", """
+        import os
+
+        def lock_fd(path):
+            # lint: fsio-ok the flock target carries no data
+            return os.open(path, os.O_CREAT | os.O_RDWR)
+    """, select=("CB109",)) == []
+
+
+def test_fsio_rule_covers_repair_and_scrub(tmp_path):
+    """The repair planner's in-place rewrite path joined the scope
+    with ISSUE 14: any future direct disk op there must surface."""
+    for i, rel in enumerate(("cluster/repair.py", "cluster/scrub.py")):
+        vs = run_snippet(tmp_path / str(i), rel, """
+            import os
+
+            def rewrite(tmp, target):
+                os.replace(tmp, target)
+        """, select=("CB109",))
+        assert [v.rule for v in vs] == ["CB109"], rel
+
+
 # ---- CB201 async-blocking ----
 
 def test_async_blocking_flags_sleep_open_subprocess(tmp_path):
@@ -939,6 +1014,7 @@ def test_cli_list_rules_names_every_rule_grouped_by_family():
     proc = _run_cli("--list-rules")
     assert proc.returncode == 0
     for rid in ("CB101", "CB102", "CB103", "CB104", "CB105", "CB106",
+                "CB107", "CB108", "CB109",
                 "CB201", "CB202", "CB203", "CB204", "CB205"):
         assert rid in proc.stdout
     # family grouping with one-line hazard descriptions

@@ -392,6 +392,59 @@ def test_config15_failure_emits_one_json_line():
     assert "error" in rec
 
 
+def test_config16_smoke_emits_one_json_line():
+    """--config 16 --smoke (crash-consistency matrix at CI scale:
+    three mutations plus the power-cut scrub-recovery images) honors
+    the driver contract: exactly one parseable JSON line on stdout
+    with the required keys, exit 0 — and the run itself asserts every
+    enumerated crash image recovers invariant-clean, ``scrub --once``
+    converges the journal-line-without-slab-bytes power-cut image to
+    Valid, and the same-seed determinism double-run (identical
+    op-stream + verdict digest)."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "16", "--smoke"],
+        cwd=REPO, env=env, capture_output=True, timeout=300)
+    assert r.returncode == 0, r.stderr.decode()[-800:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "mutations",
+                "crash_points", "images", "images_ok",
+                "cluster_images", "cluster_images_ok",
+                "deterministic", "digest", "rows"):
+        assert key in rec
+    assert rec["unit"] == "images"
+    # the acceptance criterion, observed live: EVERY enumerated crash
+    # point recovered clean (ratio pinned at 1.0), deterministically
+    assert rec["value"] > 100
+    assert rec["vs_baseline"] == 1.0
+    assert rec["images_ok"] == rec["images"]
+    assert rec["cluster_images_ok"] == rec["cluster_images"] > 0
+    assert rec["deterministic"] is True
+    for row in rec["rows"]:
+        assert row["images_ok"] == row["images"], row
+
+
+def test_config16_failure_emits_one_json_line():
+    """ANY --config 16 failure (here: an unknown mutation name) still
+    produces exactly one parseable JSON line and exit 3 — the same
+    contract as configs 8-15 and the device runs."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "16",
+         "--mutations", "heat_death"],
+        cwd=REPO, env=env, capture_output=True, timeout=120)
+    assert r.returncode == 3, r.stderr.decode()[-500:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec
+    assert rec["value"] == 0.0
+    assert "error" in rec
+
+
 def test_seams_only_shrink_and_tolerate_garbage():
     """Inherited env values must not break the contract: malformed or
     larger-than-default values fall back to the real budget."""

@@ -317,6 +317,14 @@ _KEY_VERDICTS = {
                       "slo_no_false_positives"),
     "slow_leak": ("converged", "corruption_detected",
                   "slo_no_false_positives"),
+    # the disk-fault axis (ISSUE 14): a corruption burst plus silent
+    # torn and ENOSPC-refused repair writes — all absorbed by
+    # scrub/repair, never client-visible, the engine stays silent
+    "disk_corruption_storm": ("converged", "corruption_detected",
+                              "torn_writes_ridden_out",
+                              "disk_full_ridden_out",
+                              "reads_clean_outside_fault",
+                              "slo_no_false_positives"),
     # total connectivity loss: scrub-stall + breaker + fallback-storm
     # all detected, all resolved after the heal
     "fleet_partition": ("converged",
