@@ -54,6 +54,15 @@ HOST_THREADS_ENV = "CHUNKY_BITS_TPU_HOST_THREADS"
 #: default resolution in ops/backend.get_backend reads it
 BACKEND_ENV = "CHUNKY_BITS_TPU_BACKEND"
 
+#: bounded in-flight depth of the device dispatch window
+#: (ops/dispatch_pipeline.py, used by the ``mesh`` backend): 2 (the
+#: default) is the classic double buffer — batch k+1 stages while
+#: batch k computes and batch k-1 drains; 1 keeps a single dispatch in
+#: flight; 0 disables overlap (every dispatch materializes
+#: synchronously — bench --config 17's "off" leg).  Read at pipeline
+#: construction (first backend use).
+DISPATCH_DEPTH_ENV = "CHUNKY_BITS_TPU_DISPATCH_DEPTH"
+
 #: hedged-read delay floor in milliseconds (cluster/health.py): after
 #: this long (adaptively stretched to the scoreboard's p95, ceiling
 #: 20x) a chunk read races the next-best location.  0/unset = hedging
@@ -232,6 +241,20 @@ def host_threads(*, default: int = 0) -> int:
     except ValueError:
         return default
     return v if v > 0 else default
+
+
+def dispatch_depth(*, default: int = 2) -> int:
+    """Requested dispatch-window depth from
+    ``$CHUNKY_BITS_TPU_DISPATCH_DEPTH``; unset/malformed/negative reads
+    as ``default``.  Lenient like ``host_threads`` — a perf knob can
+    only *tune*, never crash, process startup.  0 is a valid value
+    (overlap off, fully serial dispatch)."""
+    raw = os.environ.get(DISPATCH_DEPTH_ENV, "")
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v >= 0 else default
 
 
 def sanitize_enabled() -> bool:
@@ -480,10 +503,11 @@ class Tunables:
     slo: dict = field(default_factory=dict)
 
     def is_device_backend(self) -> bool:
-        """True when the erasure plane runs on an accelerator ("jax" or a
-        mesh spec like "jax:dp4,sp2") — the regime where batching layers
-        amortize dispatch overhead."""
-        return (self.backend or "").startswith("jax")
+        """True when the erasure plane runs on an accelerator ("jax", a
+        mesh spec like "jax:dp4,sp2", or the auto-laid-out "mesh") — the
+        regime where batching layers amortize dispatch overhead."""
+        b = self.backend or ""
+        return b.startswith("jax") or b == "mesh"
 
     def __post_init__(self) -> None:
         self._location_context = LocationContext(

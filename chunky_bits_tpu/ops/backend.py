@@ -14,8 +14,12 @@ stacked shards — and the coder builds the encode/decode matrices on the host
 * ``native`` — C++ table codec via ctypes (ops/cpu_backend.py); the CPU
   oracle, byte-identical to the reference's crate.
 * ``jax``    — batched bit-plane matmuls on TPU (ops/jax_backend.py).
+* ``mesh``   — the same bit-plane kernels sharded over every visible
+  device with per-dispatch layout selection and a double-buffered
+  dispatch window (ops/mesh_backend.py); ``jax:dp4,sp2`` pins one
+  explicit mesh instead (parallel/backend.py).
 
-All three produce byte-identical shards; tests assert it.
+All of them produce byte-identical shards; tests assert it.
 """
 
 from __future__ import annotations
@@ -160,6 +164,19 @@ def get_backend(name: Optional[str] = None) -> ErasureBackend:
         backend = _build_device_backend(name, JaxBackend,
                                         "jax erasure backend")
         if backend.name != "jax":  # degraded: cache under requested name
+            with _REGISTRY_LOCK:
+                _REGISTRY[name] = backend
+            return backend
+    elif name == "mesh":
+        # every visible device, per-dispatch auto layout, double-buffered
+        # dispatch window (ops/mesh_backend.py); same degrade contract as
+        # "jax" — a device-init timeout caches the CPU fallback under the
+        # requested name so the process pays the timeout once
+        from chunky_bits_tpu.ops.mesh_backend import MeshBackend
+
+        backend = _build_device_backend(name, MeshBackend,
+                                        "mesh erasure backend")
+        if backend.name != "mesh":  # degraded: cache under requested name
             with _REGISTRY_LOCK:
                 _REGISTRY[name] = backend
             return backend
@@ -346,6 +363,73 @@ class ErasureCoder:
         parity_digests = np.empty((b, self.parity, 32), dtype=np.uint8)
         hash_rows(parity, parity_digests)
         return parity, np.concatenate([data_digests, parity_digests], axis=1)
+
+    def encode_hash_batches(
+        self, batches: Sequence[np.ndarray]
+    ) -> list[tuple[np.ndarray, np.ndarray]]:
+        """Feed-ahead ingest for several same-geometry batches: one
+        ``(parity, digests)`` pair per input batch, byte-identical to
+        calling ``encode_hash_batch`` per batch.
+
+        On backends exposing a ``submit_apply`` staging surface (the
+        ``mesh`` backend's dispatch pipeline), EVERY batch's dispatch is
+        staged before any is collected, so batch k+1's H2D and the host
+        hash stage run while batch k computes — the batching layer
+        (ops/batching.py) routes merged groups here instead of paying
+        the concatenate-and-slice copy.  Other backends just loop.
+        """
+        submit = getattr(self.backend, "submit_apply", None)
+        if (submit is None or not self.parity
+                or type(self).encode_batch is not ErasureCoder.encode_batch):
+            # no staging surface, nothing to overlap (p=0), or a
+            # subclass with its own encode math (pm-msr decomposes into
+            # sub-symbol applies — those pipeline at block level inside
+            # apply_matrix instead)
+            return [self.encode_hash_batch(b) for b in batches]
+        from chunky_bits_tpu.parallel.host_pipeline import (
+            get_host_pipeline,
+            join_jobs,
+        )
+
+        pipe = get_host_pipeline()
+        hash_rows = row_hasher()
+        staged = []
+        for data in batches:
+            if data.ndim != 3 or data.shape[1] != self.data:
+                raise ErasureError(
+                    f"expected data shaped [B, {self.data}, S], "
+                    f"got {data.shape}")
+            data = np.ascontiguousarray(data)
+            b = data.shape[0]
+            data_digests = np.empty((b, self.data, 32), dtype=np.uint8)
+            parity_digests = np.empty((b, self.parity, 32), dtype=np.uint8)
+            jobs = list(pipe.hash_rows_jobs(data, data_digests))
+            covered = np.zeros(b, dtype=bool)
+
+            def on_block(lo, arr, jobs=jobs, covered=covered,
+                         pd=parity_digests):
+                covered[lo:lo + arr.shape[0]] = True
+                jobs.extend(pipe.hash_rows_jobs(
+                    arr, pd[lo:lo + arr.shape[0]]))
+
+            ticket = submit(self.parity_rows, data, on_block=on_block)
+            staged.append((ticket, jobs, covered, data_digests,
+                           parity_digests))
+        out = []
+        for ticket, jobs, covered, data_digests, parity_digests in staged:
+            parity = ticket.result()
+            join_jobs(jobs)
+            if not covered.all():
+                # rows the callback never saw (mid-run degrade's CPU
+                # recompute) hash from the parity actually returned
+                idx = np.flatnonzero(~covered)
+                rest = np.empty((len(idx), self.parity, 32),
+                                dtype=np.uint8)
+                hash_rows(np.ascontiguousarray(parity[idx]), rest)
+                parity_digests[idx] = rest
+            out.append((parity, np.concatenate(
+                [data_digests, parity_digests], axis=1)))
+        return out
 
     def reconstruct_batch(
         self, shards: np.ndarray, present: Sequence[int],

@@ -214,9 +214,14 @@ class EncodeHashBatcher(_CoalescingBatcher):
     ``prefers_merged_batches`` policy (see ``_run_group``): device
     backends earn the merge's extra concatenate copy back in saved
     per-dispatch RPC; CPU backends run the group's batches back-to-back
-    unmerged.  The cluster wires a shared instance only for device
-    backends — CPU writes already amortize per-part overhead through the
-    writer's zero-copy staging.
+    unmerged.  Backends exposing a ``submit_apply`` staging surface (the
+    ``mesh`` backend's double-buffered dispatch pipeline) supersede the
+    merge entirely: the group routes through
+    ``ErasureCoder.encode_hash_batches``, which stages every batch's
+    dispatch ahead of collection — the same saved per-dispatch RPC
+    without paying the concatenate memcpy.  The cluster wires a shared
+    instance only for device backends — CPU writes already amortize
+    per-part overhead through the writer's zero-copy staging.
 
     ``host_pipeline`` (a parallel.host_pipeline.HostPipeline) routes each
     dispatch's host compute through the shared multi-core executor —
@@ -267,6 +272,17 @@ class EncodeHashBatcher(_CoalescingBatcher):
         # pure loss (measured: the merge halved config-2 throughput on a
         # 1-core host) — run their batches back-to-back unmerged.
         merge = getattr(coder.backend, "prefers_merged_batches", False)
+        if merge and getattr(coder.backend, "submit_apply", None) is not None:
+            # Feed-ahead: every batch's dispatch is staged into the
+            # backend's bounded window before any is collected, so the
+            # device chews batch k+1 while the host hashes batch k.
+            # Same shared-fate contract as the merged dispatch below
+            # (one failure fails the group), minus the concatenate
+            # memcpy; host hashing overlaps inside
+            # encode_hash_batches, so the host_pipeline slicing path
+            # is deliberately bypassed here.
+            self.dispatches += len(batches)
+            return coder.encode_hash_batches(batches)
         if not merge or len(batches) == 1:
             # Unmerged batches are independent dispatches that happen to
             # share a drain tick: a failure belongs to its own waiter

@@ -192,9 +192,14 @@ def _device_matrix(impl: str, mat: np.ndarray):
 
 
 @functools.lru_cache(maxsize=32)
-def _sharded_apply_fn(mesh, impl: str):
-    """Jitted shard_mapped transform, cached per (mesh, impl) so repeated
-    calls reuse the XLA executable instead of retracing."""
+def _sharded_apply_fn(mesh, impl: str, donate: bool = False):
+    """Jitted shard_mapped transform, cached per (mesh, impl, donate) so
+    repeated calls reuse the XLA executable instead of retracing.
+    ``donate`` hands the staged shards buffer back to the allocator
+    (double-buffered dispatch keeps two in flight; donation halves the
+    device-memory high-water mark) — TPU meshes only: on CPU jax may
+    alias the caller's host numpy memory zero-copy, and donating an
+    aliased buffer could corrupt it."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -204,16 +209,19 @@ def _sharded_apply_fn(mesh, impl: str):
         in_specs=(P(None, None), P("dp", None, "sp")),
         out_specs=P("dp", None, "sp"),
         impl=impl,
-    ))
+    ), donate_argnums=(1,) if donate else ())
 
 
-def sharded_apply(mesh, mat: np.ndarray, shards, *, impl: Optional[str] = None):
+def sharded_apply(mesh, mat: np.ndarray, shards, *,
+                  impl: Optional[str] = None, donate: bool = False):
     """out[B, R, S] = mat ⊗ shards with B split over 'dp' and S over 'sp'.
 
     Parts are independent and the transform is element-wise over S, so both
     shardings are embarrassingly parallel — XLA inserts only the final
     all-gather to deliver the replicated-out result.  ``impl`` overrides
-    the per-chip transform choice (tests force "pallas_interpret").
+    the per-chip transform choice (tests force "pallas_interpret");
+    ``donate`` releases the staged input buffer to the allocator (TPU
+    meshes only — see ``_sharded_apply_fn``).
     """
     import jax.numpy as jnp
 
@@ -224,7 +232,7 @@ def sharded_apply(mesh, mat: np.ndarray, shards, *, impl: Optional[str] = None):
         impl = _auto_impl(mesh, r, k, s // mesh.shape["sp"])
     _check_impl(impl)
     m2 = _device_matrix(impl, mat)
-    return _sharded_apply_fn(mesh, impl)(m2, jnp.asarray(shards))
+    return _sharded_apply_fn(mesh, impl, donate)(m2, jnp.asarray(shards))
 
 
 @functools.lru_cache(maxsize=32)
@@ -274,7 +282,7 @@ def encode_step_sharded(mesh, encode_matrix: np.ndarray, data,
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=32)
-def _wide_apply_fn(mesh, impl: str):
+def _wide_apply_fn(mesh, impl: str, donate: bool = False):
     """Jitted transform with the GF contraction split over 'tp'.
 
     Each chip holds a [B/dp, K/tp, S] slice of the input shards and the
@@ -322,7 +330,7 @@ def _wide_apply_fn(mesh, impl: str):
         in_specs=(m2_spec, P("dp", "tp", None)),
         out_specs=P("dp", None, None),
         impl=impl,
-    ))
+    ), donate_argnums=(1,) if donate else ())
 
 
 @functools.lru_cache(maxsize=16)
@@ -342,12 +350,15 @@ def _host_bitmajor_blocks(mat_bytes: bytes, r: int, k: int,
 
 
 def wide_apply_sharded(mesh, mat: np.ndarray, shards,
-                       *, impl: Optional[str] = None):
+                       *, impl: Optional[str] = None,
+                       donate: bool = False):
     """out[B, R, S] = mat ⊗ shards with B over 'dp' and the K (stripe)
     axis over 'tp'.  ``mat`` is a GF(2^8) matrix [R, K] (parity rows for
     encode, host-inverted rows for decode — the same primitive serves
     both, like the reference's encode_sep/reconstruct pair at
-    src/file/file_part.rs:161,302).  'tp' must divide K.
+    src/file/file_part.rs:161,302).  'tp' must divide K.  ``donate``
+    releases the staged input buffer to the allocator (TPU meshes only —
+    see ``_sharded_apply_fn``).
     """
     import jax.numpy as jnp
 
@@ -364,7 +375,7 @@ def wide_apply_sharded(mesh, mat: np.ndarray, shards,
     else:
         m2 = jnp.asarray(_host_bitmajor_blocks(mat.tobytes(), r, k, tp),
                          dtype=jnp.int8)
-    return _wide_apply_fn(mesh, impl)(m2, jnp.asarray(shards))
+    return _wide_apply_fn(mesh, impl, donate)(m2, jnp.asarray(shards))
 
 
 def encode_wide_sharded(mesh, encode_matrix: np.ndarray, data,

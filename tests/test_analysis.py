@@ -301,6 +301,42 @@ def test_xor_schedule_module_is_in_cb101_cb105_scope(tmp_path):
         assert [v.rule for v in vs] == [rid], rid
 
 
+def test_mesh_modules_are_in_cb101_cb105_scope(tmp_path):
+    """The mesh backend and its dispatch pipeline (ISSUE 16) ARE the
+    device dispatch path: both must sit inside the bounded-wait
+    (CB101) and jit-hygiene (CB105) scopes — an unbounded wait here is
+    exactly the tunnel-down hang the degrade invariant forbids, and a
+    device concat here is exactly the odd-width u8 XLA quirk the LANE
+    padding exists for.  Must-flag fixtures per module per rule; the
+    shipped modules themselves are clean with zero baseline entries
+    (test_shipped_tree_is_clean pins that tree-wide)."""
+    for rel in ("ops/mesh_backend.py", "ops/dispatch_pipeline.py"):
+        for rid, src in (("CB101", """
+            async def f(task):
+                return await task
+        """), ("CB105", """
+            import jax.numpy as jnp
+
+            def f(a, b):
+                return jnp.concatenate([a, b], axis=1)
+        """)):
+            vs = run_snippet(tmp_path / rid / rel.replace("/", "_"),
+                             rel, src, select=(rid,))
+            assert [v.rule for v in vs] == [rid], (rel, rid)
+        # and the bounded idioms the shipped modules actually use pass:
+        # handle waits ride run_bounded_dispatch, window sync is a
+        # plain (non-async) lock — nothing for CB101 to flag
+        vs = run_snippet(tmp_path / "ok" / rel.replace("/", "_"), rel,
+                         """
+            import threading
+
+            def drain(lock: threading.Lock, entries: list) -> None:
+                with lock:
+                    entries.clear()
+        """, select=("CB101", "CB105"))
+        assert vs == [], rel
+
+
 # ---- CB106 public-annotations ----
 
 def test_missing_annotations_flagged_on_strict_module(tmp_path):

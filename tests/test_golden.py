@@ -259,7 +259,7 @@ def test_old_reference_without_blocks_parses_and_roundtrips():
     assert gen.dump(ref.to_obj()) == golden_text("void_small")
 
 
-@pytest.mark.parametrize("backend", ["numpy", "native", "jax"])
+@pytest.mark.parametrize("backend", ["numpy", "native", "jax", "mesh"])
 def test_wide_fixture_backend_byte_identity(backend):
     """Every erasure backend must reproduce the frozen d=10 p=4 reference
     exactly — parity hashes pin the matrix convention byte-for-byte."""
@@ -285,3 +285,35 @@ def test_wide_fixture_backend_byte_identity(backend):
 
     ref = asyncio.run(build())
     assert gen.dump(ref.to_obj()) == golden_text("void_wide")
+
+
+def test_wide_fixture_mesh_env_default_byte_identity():
+    """$CHUNKY_BITS_TPU_BACKEND=mesh as the FLEET-WIDE default (the CI
+    matrix leg's shape, no per-writer ``.with_backend()``) reproduces
+    the frozen reference byte-for-byte — in a fresh interpreter so the
+    env is read at first dispatch, exactly as a deployment would."""
+    import subprocess
+    import sys
+
+    from chunky_bits_tpu.utils.virtualmesh import provision_virtual_mesh
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo,
+               CHUNKY_BITS_TPU_BACKEND="mesh")
+    provision_virtual_mesh(env, 8)
+    script = (
+        "import asyncio, sys\n"
+        "from chunky_bits_tpu.file import FileWriteBuilder\n"
+        "from chunky_bits_tpu.utils import aio\n"
+        "from tests.golden import generate as gen\n"
+        "ref = asyncio.run(FileWriteBuilder()\n"
+        "    .with_chunk_size(1 << 12)\n"
+        "    .with_data_chunks(10).with_parity_chunks(4)\n"
+        "    .with_batch_parts(2)\n"
+        "    .write(aio.BytesReader(\n"
+        "        gen.payload(3 * 10 * (1 << 12) + 777, 2))))\n"
+        "sys.stdout.write(gen.dump(ref.to_obj()))\n")
+    r = subprocess.run([sys.executable, "-c", script], cwd=repo,
+                       env=env, capture_output=True, timeout=300)
+    assert r.returncode == 0, r.stderr.decode()[-800:]
+    assert r.stdout.decode() == golden_text("void_wide")

@@ -1,0 +1,289 @@
+"""Mesh erasure backend + dispatch pipeline (ISSUE 16).
+
+The multi-device ``mesh`` backend (ops/mesh_backend.py) on conftest's
+8-device virtual CPU mesh: layout planning (incl. the LANE-padding pin
+for this jax build's odd-width u8 XLA quirk), byte identity against the
+numpy oracle across geometries, the double-buffered feed-ahead proven
+from the pipeline's own counters, and the degrade-never-hang contract
+after a mid-run dispatch timeout.  The :class:`DispatchPipeline` itself
+is device-agnostic and unit-tested here with plain callables.
+"""
+
+import numpy as np
+import pytest
+
+from chunky_bits_tpu.cluster import tunables
+from chunky_bits_tpu.errors import DeviceDispatchTimeout
+from chunky_bits_tpu.ops import matrix
+from chunky_bits_tpu.ops.backend import ErasureCoder, NumpyBackend
+from chunky_bits_tpu.ops.dispatch_pipeline import (
+    DEFAULT_DEPTH,
+    DispatchCancelled,
+    DispatchPipeline,
+)
+from chunky_bits_tpu.ops.mesh_backend import (
+    LANE,
+    WIDE_STRIPE_MIN_K,
+    MeshBackend,
+    plan_layout,
+)
+
+rng = np.random.default_rng(16)
+
+
+# ---------------------------------------------------------------- pipeline
+
+def test_pipeline_double_buffer_window():
+    """depth=2 holds at most two un-materialized dispatches: the third
+    submit drains the oldest, FIFO."""
+    drained = []
+    pipe = DispatchPipeline(depth=2)
+    entries = [pipe.submit(lambda i=i: i,
+                           lambda h: drained.append(h) or h * 10)
+               for i in range(4)]
+    # submits 3 and 4 each forced one oldest-first materialization
+    assert drained == [0, 1]
+    assert pipe.inflight == 2
+    assert [pipe.result(e) for e in entries] == [0, 10, 20, 30]
+    assert drained == [0, 1, 2, 3]
+    st = pipe.stats()
+    assert st.submitted == st.completed == 4
+    # the peak counts the submit being admitted (depth + 1, before the
+    # drain brings the window back under the bound)
+    assert st.max_inflight == 3
+    assert st.submits_while_busy == 3
+    assert st.cancelled == 0
+
+
+def test_pipeline_depth_zero_is_serial():
+    """depth=0 (the bench A/B's off leg) materializes inside submit —
+    no overlap window ever exists."""
+    pipe = DispatchPipeline(depth=0)
+    for i in range(3):
+        e = pipe.submit(lambda i=i: i, lambda h: h + 1)
+        assert pipe.inflight == 0
+        assert pipe.result(e) == i + 1
+    st = pipe.stats()
+    assert st.max_inflight <= 1
+    assert st.submits_while_busy == 0
+
+
+def test_pipeline_result_is_idempotent_and_out_of_order():
+    pipe = DispatchPipeline(depth=4)
+    a = pipe.submit(lambda: "a", lambda h: h)
+    b = pipe.submit(lambda: "b", lambda h: h)
+    # asking for the younger first drains the older too (FIFO bound)
+    assert pipe.result(b) == "b"
+    assert pipe.result(a) == "a"
+    assert pipe.result(a) == "a"
+
+
+def test_pipeline_cancel_drops_without_touching_handles():
+    pipe = DispatchPipeline(depth=4)
+    touched = []
+    e = pipe.submit(lambda: "handle", lambda h: touched.append(h))
+    pipe.cancel()
+    assert pipe.inflight == 0
+    with pytest.raises(DispatchCancelled):
+        pipe.result(e)
+    assert touched == []  # the dead device was never waited on
+    assert pipe.stats().cancelled == 1
+
+
+def test_pipeline_failure_poisons_younger_entries():
+    """A failed materialization (the device died) cancels everything
+    younger instead of re-paying the timeout per entry."""
+    pipe = DispatchPipeline(depth=4)
+
+    def boom(_handle):
+        raise DeviceDispatchTimeout("tunnel died")
+
+    bad = pipe.submit(lambda: None, boom)
+    young = pipe.submit(lambda: None, lambda h: h)
+    with pytest.raises(DeviceDispatchTimeout):
+        pipe.result(bad)
+    with pytest.raises(DispatchCancelled):
+        pipe.result(young)
+    st = pipe.stats()
+    assert st.cancelled == 1 and st.completed == 0
+
+
+def test_pipeline_depth_env_tunable(monkeypatch):
+    monkeypatch.setenv(tunables.DISPATCH_DEPTH_ENV, "3")
+    assert DispatchPipeline().depth == 3
+    # 0 is a valid, meaningful setting (overlap off) — not "unset"
+    monkeypatch.setenv(tunables.DISPATCH_DEPTH_ENV, "0")
+    assert DispatchPipeline().depth == 0
+    # malformed/negative values fall back to the default, loudly never
+    for bad in ("two", "-1", "1.5"):
+        monkeypatch.setenv(tunables.DISPATCH_DEPTH_ENV, bad)
+        assert DispatchPipeline().depth == DEFAULT_DEPTH
+    monkeypatch.delenv(tunables.DISPATCH_DEPTH_ENV)
+    assert DispatchPipeline().depth == DEFAULT_DEPTH
+
+
+# ------------------------------------------------------------- plan_layout
+
+def test_plan_layout_batch_parallel_fills_dp():
+    lay = plan_layout(8, 16, 10, 4096)
+    assert (lay.wide, lay.dp, lay.minor, lay.pad_s) == (False, 8, 1, 0)
+
+
+def test_plan_layout_dp_is_largest_divisor_at_most_batch():
+    # batch 6 on 8 devices: 6 doesn't divide 8, dp falls to 4
+    lay = plan_layout(8, 6, 10, 4096)
+    assert lay.dp == 4 and lay.minor == 2
+
+
+def test_plan_layout_wide_stripe_splits_contraction():
+    lay = plan_layout(8, 2, 20, 4096)
+    assert lay.wide and lay.dp == 2 and lay.minor == 4 and lay.pad_s == 0
+    assert 20 % lay.minor == 0  # integral k split, no ragged psum
+
+
+def test_plan_layout_narrow_stripe_never_wide():
+    # k below the threshold keeps the element-wise 'sp' split even
+    # when k happens to divide the minor extent
+    lay = plan_layout(8, 2, 4, 4096)
+    assert not lay.wide and lay.minor == 4
+    assert 4 < WIDE_STRIPE_MIN_K
+
+
+@pytest.mark.parametrize("s", [1, 63, 777, 4096, 4097])
+def test_plan_layout_sp_slices_stay_lane_aligned(s):
+    """The XLA-CPU-quirk pin (CLAUDE.md): every per-device byte slice
+    of an 'sp'-sharded dispatch must be a whole multiple of LANE=64 —
+    this jax build misbehaves on odd-width u8 device buffers."""
+    lay = plan_layout(8, 2, 10, s)
+    assert not lay.wide and lay.minor > 1
+    padded = s + lay.pad_s
+    assert padded % lay.minor == 0
+    per_device = padded // lay.minor
+    assert per_device % LANE == 0, (s, lay)
+    assert lay.pad_s < lay.minor * LANE  # minimal padding only
+
+
+def test_plan_layout_pure_dp_needs_no_padding():
+    # when the batch covers the mesh there is no byte axis to pad
+    assert plan_layout(8, 8, 10, 777).pad_s == 0
+
+
+def test_plan_layout_zero_batch_is_safe():
+    lay = plan_layout(8, 0, 10, 4096)
+    assert lay.dp == 1
+
+
+# ------------------------------------------------------------ mesh backend
+
+@pytest.fixture(scope="module")
+def mesh_be():
+    return MeshBackend()
+
+
+@pytest.mark.parametrize("d,p,b,s", [
+    (10, 4, 16, 4096),   # batch-parallel pure 'dp'
+    (10, 4, 3, 1000),    # non-divisible batch AND byte length ('sp' pad)
+    (10, 4, 2, 1),       # degenerate 1-byte shards
+    (20, 6, 2, 256),     # wide-stripe ('dp','tp') with the psum
+    (4, 2, 5, 777),      # narrow stripe, odd everything
+])
+def test_mesh_identity_across_geometries(mesh_be, d, p, b, s):
+    enc = matrix.build_encode_matrix(d, p)
+    data = rng.integers(0, 256, (b, d, s), dtype=np.uint8)
+    got = mesh_be.apply_matrix(enc[d:], data)
+    want = NumpyBackend().apply_matrix(enc[d:], data)
+    assert got.dtype == np.uint8 and got.shape == (b, p, s)
+    assert np.array_equal(got, want)
+
+
+def test_mesh_decode_with_erasures(mesh_be):
+    d, p = 10, 4
+    enc = matrix.build_encode_matrix(d, p)
+    data = rng.integers(0, 256, (4, d, 512), dtype=np.uint8)
+    parity = NumpyBackend().apply_matrix(enc[d:], data)
+    present = [0, 2, 3, 4, 5, 6, 7, 8, 9, 10]
+    dec = matrix.decode_matrix(enc, present, [1])
+    picked = np.concatenate([data[:, :1], data[:, 2:], parity[:, :1]],
+                            axis=1)
+    rebuilt = mesh_be.apply_matrix(dec, picked)
+    assert np.array_equal(rebuilt[:, 0], data[:, 1])
+
+
+def test_mesh_encode_hash_identity(mesh_be):
+    d, p = 10, 4
+    data = rng.integers(0, 256, (6, d, 1024), dtype=np.uint8)
+    parity, digests = ErasureCoder(d, p, mesh_be).encode_hash_batch(data)
+    owant, odig = ErasureCoder(d, p, NumpyBackend()).encode_hash_batch(
+        data)
+    assert np.array_equal(parity, owant)
+    assert np.array_equal(digests, odig)
+
+
+def test_mesh_feed_ahead_counters_prove_overlap():
+    """encode_hash_batches stages every batch before collecting any:
+    the pipeline's own counters show >= 2 dispatches in flight."""
+    be = MeshBackend(depth=2)
+    d, p = 10, 4
+    data = rng.integers(0, 256, (8, d, 512), dtype=np.uint8)
+    coder = ErasureCoder(d, p, be)
+    outs = coder.encode_hash_batches([data[:4], data[4:]])
+    owant, odig = ErasureCoder(d, p, NumpyBackend()).encode_hash_batch(
+        data)
+    assert np.array_equal(np.concatenate([o[0] for o in outs]), owant)
+    assert np.array_equal(np.concatenate([o[1] for o in outs]), odig)
+    st = be.pipeline.stats()
+    assert st.completed == st.submitted >= 2
+    assert st.max_inflight >= 2
+    assert st.submits_while_busy >= 1
+    assert st.cancelled == 0
+
+
+def test_mesh_depth_zero_still_identical():
+    be = MeshBackend(depth=0)
+    d, p = 10, 4
+    enc = matrix.build_encode_matrix(d, p)
+    data = rng.integers(0, 256, (4, d, 640), dtype=np.uint8)
+    assert np.array_equal(be.apply_matrix(enc[d:], data),
+                          NumpyBackend().apply_matrix(enc[d:], data))
+    st = be.pipeline.stats()
+    assert st.max_inflight <= 1 and st.submits_while_busy == 0
+
+
+def test_mesh_degrade_sticky_cpu_byte_identical(monkeypatch):
+    """A dispatch timeout mid-run (the tunnel dying) degrades the
+    backend to the CPU fallback — loudly, once, sticky — and every
+    result, including the digests of rows the block callback never
+    saw, stays byte-identical."""
+    be = MeshBackend()
+    d, p = 10, 4
+    data = rng.integers(0, 256, (4, d, 512), dtype=np.uint8)
+
+    def dead_device(_handle):
+        raise DeviceDispatchTimeout("mesh erasure dispatch timed out")
+
+    monkeypatch.setattr(be, "_materialize", dead_device)
+    owant, odig = ErasureCoder(d, p, NumpyBackend()).encode_hash_batch(
+        data)
+    with pytest.warns(RuntimeWarning, match="DEGRADED"):
+        parity, digests = ErasureCoder(d, p, be).encode_hash_batch(data)
+    assert np.array_equal(parity, owant)
+    assert np.array_equal(digests, odig)  # unseen rows were reconciled
+    assert be._device_dead
+    # sticky: later calls go straight to CPU — the dead materializer
+    # would raise again if the device were ever touched
+    enc = matrix.build_encode_matrix(d, p)
+    assert np.array_equal(be.apply_matrix(enc[d:], data),
+                          NumpyBackend().apply_matrix(enc[d:], data))
+
+
+def test_mesh_registered_backend_and_tunable():
+    from chunky_bits_tpu.ops import backend as backend_mod
+
+    be = backend_mod.get_backend("mesh")
+    assert be.name == "mesh"
+    assert backend_mod.get_backend("mesh") is be  # cached
+    assert be.async_dispatch and be.prefers_merged_batches
+    # the batching layers treat mesh as a device backend (dispatch
+    # amortization on, merged groups routed through the feed-ahead)
+    assert tunables.Tunables(backend="mesh").is_device_backend()
+    assert not tunables.Tunables(backend="native").is_device_backend()

@@ -329,6 +329,10 @@ def test_golden_fixtures_identical_with_flag_on():
     env = dict(os.environ, PYTHONPATH=REPO,
                CHUNKY_BITS_TPU_XOR_SCHEDULE="1",
                JAX_PLATFORMS="cpu")
+    # the engine lives in the native backend: a fleet-wide backend
+    # override (the CI mesh/jax matrix legs) would route every dispatch
+    # around it and make the engine-dispatched assert vacuous
+    env.pop("CHUNKY_BITS_TPU_BACKEND", None)
     r = subprocess.run([sys.executable, "-c", prog], cwd=REPO, env=env,
                        capture_output=True, timeout=300)
     assert r.returncode == 0, r.stderr.decode()[-800:]
