@@ -38,6 +38,32 @@ CB2xx — concurrency hazards of the two-plane host/async runtime
 - serve-path singletons are per-event-loop            -> CB205
   ``loop-shared``
 
+CB3xx — whole-program reachability (flow.py over the shared
+function-granular call graph in callgraph.py + reachability.py;
+``--select CB3`` runs the family alone; ``--explain CB3xx`` prints any
+rule's full rationale, ``--graph-stats`` reports graph precision):
+
+- crash harness replays only seam-recorded mutations:
+  no durability op off-seam anywhere a durability root
+  (slab append/compact, publish, metadata write,
+  repair rewrite) can reach                           -> CB301
+  ``fsio-escape``
+- same seed => byte-identical trace: no wall-clock
+  read anywhere a sim scenario can reach              -> CB302
+  ``clock-escape``
+- cancellation must propagate (never swallowed),
+  complete (cancel() is awaited), and never strand a
+  write->replace publish window                       -> CB303
+  ``cancel-safety``
+- production planes import NOTHING from sim/ — proven
+  statically incl. lazy in-function imports (the
+  runtime subprocess pin in tests/test_sim.py covers
+  the default import closure; both stay)              -> CB304
+  ``sim-purity``
+- closed-set metric labels hold at the CALL SITES of
+  functions that feed parameters into ``.labels()``   -> CB305
+  ``label-flow``
+
 The runtime side of the same contract lives in ``sanitizer.py``: an
 opt-in (``$CHUNKY_BITS_TPU_SANITIZE``) loop-stall watchdog, task-leak
 registry, and HostPipeline handoff checker.  It is deliberately NOT

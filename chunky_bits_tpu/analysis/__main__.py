@@ -53,8 +53,39 @@ def main(argv: list[str] | None = None) -> int:
              "(e.g. CB101,CB104 — or CB2 for the whole CB2xx family)")
     parser.add_argument("--json", action="store_true",
                         help="emit one JSON object instead of text")
+    parser.add_argument(
+        "--format", choices=("text", "github"), default="text",
+        help="text (default) or github workflow-annotation lines "
+             "(::error file=...) for new violations/errors")
+    parser.add_argument(
+        "--graph-stats", action="store_true",
+        help="also report call-graph statistics (functions/edges/"
+             "worker roots/unknown-edge count) so graph precision "
+             "regressions show up in the lint report")
+    parser.add_argument(
+        "--explain", metavar="RULE",
+        help="print the full rationale + fix pattern for a rule id, "
+             "family prefix (CB3), or slug, then exit")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
+
+    if args.explain:
+        want = args.explain.strip()
+        matched = [r for r in ALL_RULES
+                   if r.id.upper().startswith(want.upper())
+                   or r.slug == want.lower()]
+        if not matched:
+            parser.error(f"--explain: no rule matches {want!r}")
+        for i, rule in enumerate(matched):
+            if i:
+                print()
+            doc = (rule.__doc__ or "(no rationale recorded)").strip()
+            print(f"{rule.id} [{rule.slug}] — {rule.description}")
+            if rule.paths:
+                print(f"scope: {', '.join(rule.paths)}")
+            print()
+            print(doc)
+        return 0
 
     rules = ALL_RULES
     if args.select:
@@ -99,7 +130,9 @@ def main(argv: list[str] | None = None) -> int:
             else:
                 parser.error(f"no such path: {p}")
 
-    violations, errors = run_analysis(args.root, rules, files=files)
+    stats: dict | None = {} if args.graph_stats else None
+    violations, errors = run_analysis(args.root, rules, files=files,
+                                      stats=stats)
 
     if args.write_baseline:
         if args.select or files is not None:
@@ -126,34 +159,72 @@ def main(argv: list[str] | None = None) -> int:
             else load_baseline(args.baseline)
     except ValueError as err:
         parser.error(str(err))
-    new = [v for v in violations if v.key() not in baseline]
-    matched = {v.key() for v in violations} & baseline
-    stale = len(baseline) - len(matched)
+    # a finding matches through its scoped fingerprint OR the legacy
+    # no-scope spelling (pre-migration baselines keep working)
+    new = [v for v in violations
+           if not (set(v.keys()) & baseline)]
+    matched_entries = baseline & {k for v in violations
+                                  for k in v.keys()}
+    baselined = len(violations) - len(new)
+    stale = len(baseline) - len(matched_entries)
 
     if args.json:
-        print(json.dumps({
+        out = {
             "new": [{**v.__dict__, "rule_family": rule_family(v.rule)}
                     for v in new],
-            "baselined": len(matched),
+            "baselined": baselined,
             "stale_baseline_entries": stale,
             "errors": errors,
             "ok": not new and not errors,
-        }))
+        }
+        if stats is not None:
+            out["graph"] = stats
+        print(json.dumps(out))
         return 1 if (new or errors) else 0
 
-    for err in errors:
-        print(f"ERROR {err}")
-    for v in new:
-        print(v.render())
-        print(f"    {v.snippet}")
-    summary = (f"{len(new)} new violation(s), {len(matched)} baselined, "
+    if args.format == "github":
+        # workflow-annotation lines; paths are emitted relative to the
+        # process cwd (the repo checkout in CI) so the annotations
+        # attach to the right files in the diff view
+        try:
+            prefix = args.root.resolve().relative_to(
+                Path.cwd().resolve()).as_posix()
+        except ValueError:
+            prefix = ""
+        for err in errors:
+            print("::error title=chunky-bits-tpu analysis::"
+                  f"{_annotation_escape(err)}")
+        for v in new:
+            loc = f"{prefix}/{v.path}" if prefix else v.path
+            print(f"::error file={loc},line={v.line},col={v.col},"
+                  f"title={v.rule} [{v.slug}]::"
+                  f"{_annotation_escape(v.message)}")
+    else:
+        for err in errors:
+            print(f"ERROR {err}")
+        for v in new:
+            print(v.render())
+            print(f"    {v.snippet}")
+    summary = (f"{len(new)} new violation(s), {baselined} baselined, "
                f"{stale} stale baseline entr(y/ies), "
                f"{len(errors)} file error(s)")
+    if stats is not None:
+        summary += (f"; graph: {stats.get('functions', 0)} functions, "
+                    f"{stats.get('edges', 0)} edges, "
+                    f"{stats.get('worker_roots', 0)} worker roots, "
+                    f"{stats.get('unknown_edges', 0)} unknown edges")
     if new or errors:
         print(f"FAIL: {summary}")
         return 1
     print(f"ok: {summary}")
     return 0
+
+
+def _annotation_escape(text: str) -> str:
+    """GitHub workflow-command data escaping (%, CR, LF)."""
+    return (text.replace("%", "%25")
+                .replace("\r", "%0D")
+                .replace("\n", "%0A"))
 
 
 if __name__ == "__main__":

@@ -40,7 +40,6 @@ from typing import Iterator, Optional
 from chunky_bits_tpu.analysis.callgraph import (
     THREADSAFE_WRAPPERS,
     attr_chain,
-    build_call_graph,
     iter_body_nodes,
 )
 from chunky_bits_tpu.analysis.rules import Finding, Rule
@@ -345,7 +344,8 @@ class CrossPlaneHandoffRule(Rule):
     """CB204 — worker-thread code re-enters the loop only through the
     threadsafe doors.
 
-    Built on the module-granular call graph (callgraph.py): from the
+    Built on the function-granular call graph (callgraph.py), shared
+    with the CB3xx family through the per-run ProjectContext: from the
     set of functions reachable off-loop (HostPipeline worker bodies,
     thread targets, job callables, done-callbacks) it flags touches of
     loop-bound state — ``loop.call_soon``/``call_later``/``call_at``,
@@ -421,8 +421,8 @@ class CrossPlaneHandoffRule(Rule):
                         out.add(tgt.attr)
         return out
 
-    def check_project(self, sfs) -> Iterator[tuple]:
-        graph = build_call_graph(sfs)
+    def check_project(self, sfs, ctx) -> Iterator[tuple]:
+        graph = ctx.graph
         reachable = graph.worker_reachable()
         if not reachable:
             return

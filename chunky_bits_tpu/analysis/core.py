@@ -30,9 +30,16 @@ _NOQA_BLE_RE = re.compile(r"#\s*noqa:\s*BLE001\b[ \t]*[-—:]?[ \t]*(.*)")
 @dataclass(frozen=True)
 class Violation:
     """One finding.  ``fingerprint`` identifies it across unrelated
-    edits: it hashes the rule, the file, the stripped source line text,
-    and the occurrence index of that text — never the line number — so
-    a baseline survives code motion above or below the finding."""
+    edits: it hashes the rule, the file, the enclosing def/class
+    qualname, the stripped source line text, and the occurrence index
+    of that text *within that scope* — never the line number — so a
+    baseline survives both code motion AND duplicate-line churn (an
+    identical line added in a DIFFERENT function no longer shifts this
+    one's occurrence index).  ``legacy_fingerprint`` is the pre-scope
+    spelling (no qualname, file-wide occurrence): baselines written
+    before the scheme change still match through it, giving existing
+    ``baseline.toml`` files a one-shot migration path — regenerate
+    with ``--write-baseline`` to move onto scoped fingerprints."""
 
     rule: str
     slug: str
@@ -42,9 +49,19 @@ class Violation:
     message: str
     snippet: str
     fingerprint: str
+    scope: str = ""  # enclosing def/class qualname ('' = module level)
+    legacy_fingerprint: str = ""
 
     def key(self) -> tuple[str, str, str]:
         return (self.rule, self.path, self.fingerprint)
+
+    def keys(self) -> tuple[tuple[str, str, str], ...]:
+        """Every baseline key this finding matches: the scoped
+        fingerprint plus the legacy spelling (migration path)."""
+        if not self.legacy_fingerprint:
+            return (self.key(),)
+        return (self.key(),
+                (self.rule, self.path, self.legacy_fingerprint))
 
     def render(self) -> str:
         return (f"{self.path}:{self.line}:{self.col}: "
@@ -73,6 +90,43 @@ class SourceFile:
                     and isinstance(node.value, ast.Constant)
                     and isinstance(node.value.value, str)):
                 self.constants[node.targets[0].id] = node.value.value
+        #: (start, end, qualname) line intervals of every def/class,
+        #: for scope-qualified fingerprints; built once, sorted by
+        #: (start, -end) so a linear scan finds the innermost match
+        self._scopes = self._scope_intervals(self.tree)
+
+    @staticmethod
+    def _scope_intervals(tree: ast.AST) -> list[tuple[int, int, str]]:
+        out: list[tuple[int, int, str]] = []
+
+        def visit(node: ast.AST, quals: tuple[str, ...]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    q = quals + (child.name,)
+                    out.append((child.lineno,
+                                child.end_lineno or child.lineno,
+                                ".".join(q)))
+                    visit(child, q)
+                else:
+                    visit(child, quals)
+
+        visit(tree, ())
+        out.sort(key=lambda iv: (iv[0], -iv[1]))
+        return out
+
+    def scope_qualname(self, line: int) -> str:
+        """Qualname of the innermost def/class containing ``line``
+        ('' for module level).  Decorator lines belong to the scope
+        ABOVE the decorated def — same as how the finding reads."""
+        best = ""
+        for start, end, qualname in self._scopes:
+            if start > line:
+                break
+            if line <= end:
+                best = qualname  # later intervals start deeper
+        return best
 
     def _scan_comments(self) -> None:
         try:
@@ -118,9 +172,16 @@ class SourceFile:
         return ""
 
 
-def _fingerprint(rule: str, rel: str, snippet: str, occurrence: int
-                 ) -> str:
-    basis = f"{rule}\x00{rel}\x00{snippet}\x00{occurrence}"
+def _fingerprint(rule: str, rel: str, snippet: str, occurrence: int,
+                 scope: Optional[str] = None) -> str:
+    """Scoped fingerprint when ``scope`` is given (the current scheme);
+    the legacy no-scope spelling otherwise (kept so pre-migration
+    baselines still match — see Violation.keys)."""
+    if scope is None:
+        basis = f"{rule}\x00{rel}\x00{snippet}\x00{occurrence}"
+    else:
+        basis = (f"{rule}\x00{rel}\x00{scope}\x00{snippet}"
+                 f"\x00{occurrence}")
     return hashlib.sha256(basis.encode("utf-8")).hexdigest()[:16]
 
 
@@ -132,7 +193,8 @@ def iter_python_files(root: Path) -> Iterator[Path]:
 
 
 def run_analysis(root: Path, rules: Iterable[object],
-                 files: Optional[Iterable[Path]] = None
+                 files: Optional[Iterable[Path]] = None,
+                 stats: Optional[dict] = None
                  ) -> tuple[list[Violation], list[str]]:
     """Run ``rules`` over every ``*.py`` under ``root`` (or the explicit
     ``files``).  Returns ``(violations, errors)`` — a file that fails to
@@ -140,10 +202,17 @@ def run_analysis(root: Path, rules: Iterable[object],
     because the tree stopped being parseable.
 
     Two rule shapes: per-file rules implement ``check(sf)``; *project*
-    rules (``rule.project`` truthy, e.g. the CB204 cross-plane pass)
-    implement ``check_project(sfs)`` over every parsed file at once so
-    they can build a call graph before reporting.  Both feed the same
-    suppression, fingerprint, and baseline machinery."""
+    rules (``rule.project`` truthy: CB204, the CB3xx family) implement
+    ``check_project(sfs, ctx)`` over every parsed file at once, sharing
+    ONE :class:`~chunky_bits_tpu.analysis.reachability.ProjectContext`
+    (call graph + memoized reachability) so the interprocedural pass
+    parses and links the tree exactly once per run.  Both shapes feed
+    the same suppression, fingerprint, and baseline machinery.
+
+    Pass a dict as ``stats`` to receive call-graph statistics
+    (functions/edges/worker_roots/unknown_edges) — forces the graph to
+    build even when no project rule is selected (the CLI's
+    ``--graph-stats``)."""
     root = root.resolve()
     violations: list[Violation] = []
     errors: list[str] = []
@@ -183,27 +252,44 @@ def run_analysis(root: Path, rules: Iterable[object],
                 if sf.suppressed(rule.slug, line):
                     continue
                 raw_by_rel[sf.rel].append((rule, line, col, message))
+    ctx = None
+    if project or stats is not None:
+        # one shared context: every project rule reuses the same graph
+        from chunky_bits_tpu.analysis.reachability import ProjectContext
+        ctx = ProjectContext(sources)
     for rule in project:
-        scoped = [sf for sf in sources if rule.applies(sf.rel)]
-        for rel, line, col, message in rule.check_project(scoped):
+        for rel, line, col, message in rule.check_project(sources, ctx):
             sf = by_rel.get(rel)
-            if sf is None or sf.suppressed(rule.slug, line):
+            if sf is None or not rule.applies(rel) \
+                    or sf.suppressed(rule.slug, line):
                 continue
             raw_by_rel[rel].append((rule, line, col, message))
+    if stats is not None and ctx is not None:
+        stats.update(ctx.graph.stats())
     for sf in sources:
         raw = raw_by_rel[sf.rel]
-        # occurrence index among same (rule, snippet) pairs, in line
-        # order, keeps fingerprints stable under unrelated edits
+        # occurrence index among same (rule, scope, snippet) triples in
+        # line order keeps fingerprints stable under unrelated edits
+        # AND under duplicate-line churn in other scopes; the legacy
+        # (rule, snippet) counter feeds pre-migration baseline keys
         raw.sort(key=lambda item: (item[1], item[2]))
-        seen: dict[tuple[str, str], int] = {}
+        seen: dict[tuple[str, str, str], int] = {}
+        seen_legacy: dict[tuple[str, str], int] = {}
         for rule, line, col, message in raw:
             snippet = sf.line_text(line)
-            occ = seen.get((rule.id, snippet), 0)
-            seen[(rule.id, snippet)] = occ + 1
+            scope = sf.scope_qualname(line)
+            occ = seen.get((rule.id, scope, snippet), 0)
+            seen[(rule.id, scope, snippet)] = occ + 1
+            locc = seen_legacy.get((rule.id, snippet), 0)
+            seen_legacy[(rule.id, snippet)] = locc + 1
             violations.append(Violation(
                 rule=rule.id, slug=rule.slug, path=sf.rel, line=line,
                 col=col, message=message, snippet=snippet,
-                fingerprint=_fingerprint(rule.id, sf.rel, snippet, occ)))
+                fingerprint=_fingerprint(rule.id, sf.rel, snippet, occ,
+                                         scope=scope),
+                scope=scope,
+                legacy_fingerprint=_fingerprint(rule.id, sf.rel,
+                                                snippet, locc)))
     return violations, errors
 
 
@@ -214,8 +300,12 @@ def write_baseline(path: Path, violations: Iterable[Violation]) -> None:
         "# Accepted pre-existing findings — the analyzer fails only on",
         "# NEW violations.  Regenerate with:",
         "#   python -m chunky_bits_tpu.analysis --write-baseline",
-        "# Entries are (rule, path, fingerprint); line/summary are",
-        "# informational (as of writing) and ignored on load.",
+        "# Entries are (rule, path, fingerprint); line/scope/summary",
+        "# are informational (as of writing) and ignored on load.",
+        "# Fingerprints are scope-qualified (rule, path, enclosing",
+        "# qualname, line text, in-scope occurrence); entries written",
+        "# by older versions still match through the legacy no-scope",
+        "# spelling until regenerated.",
         "",
     ]
     for v in sorted(violations, key=lambda v: (v.path, v.line, v.rule)):
@@ -224,6 +314,8 @@ def write_baseline(path: Path, violations: Iterable[Violation]) -> None:
         out.append(f'path = "{v.path}"')
         out.append(f'fingerprint = "{v.fingerprint}"')
         out.append(f"line = {v.line}")
+        if v.scope:
+            out.append(f'scope = "{_toml_escape(v.scope)}"')
         out.append(f'summary = "{_toml_escape(v.message)}"')
         out.append("")
     path.write_text("\n".join(out), encoding="utf-8")

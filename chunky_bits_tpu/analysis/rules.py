@@ -652,12 +652,18 @@ FAMILY_HAZARDS = {
     "CB2xx": ("concurrency hazards of the two-plane host/async "
               "runtime: blocked loops, cross-plane handoffs, leaked "
               "tasks, loop-spanning shared state"),
+    "CB3xx": ("whole-program reachability: seam escapes beyond the "
+              "CB108/CB109 path lists, cancellation safety, sim-plane "
+              "purity, label flow across call sites — all over the "
+              "function-granular call graph (analysis/callgraph.py)"),
 }
 
-# imported at the bottom: concurrency.py needs Rule defined first
+# imported at the bottom: concurrency.py and flow.py need Rule defined
+# first
 from chunky_bits_tpu.analysis.concurrency import (  # noqa: E402
     CONCURRENCY_RULES,
 )
+from chunky_bits_tpu.analysis.flow import FLOW_RULES  # noqa: E402
 
 ALL_RULES: tuple[Rule, ...] = (
     UnboundedAwaitRule(),
@@ -669,4 +675,4 @@ ALL_RULES: tuple[Rule, ...] = (
     MetricLabelCardinalityRule(),
     ClockSeamRule(),
     FsioSeamRule(),
-) + CONCURRENCY_RULES
+) + CONCURRENCY_RULES + FLOW_RULES

@@ -351,6 +351,9 @@ async def _run_command(args, config) -> int:
                     stats = await daemon.run_once()
                     print(stats, flush=True)
                     await asyncio.sleep(max(args.interval, 0.0))
+            # lint: cancel-safety-ok top-level ctrl-c handler of the
+            # scrub command: asyncio.run is tearing this coroutine down
+            # right after — nothing awaits it as a child task
             except (KeyboardInterrupt, asyncio.CancelledError):
                 pass
     elif cmd == "stats":

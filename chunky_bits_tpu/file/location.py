@@ -521,6 +521,9 @@ class Location:
         """(simulated node, chunk name) for a sim chunk address.  The
         import is lazy and only runs for sim-kind locations — production
         paths never load the simulator (the slab: discipline)."""
+        # lint: sim-purity-ok sanctioned inversion: lazy import only on
+        # the sim: address branch; tests/test_sim.py pins that the
+        # production default-import closure never loads sim/
         from chunky_bits_tpu.sim import fabric as sim_fabric
 
         return sim_fabric.resolve(self.target)

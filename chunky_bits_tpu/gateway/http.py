@@ -1093,6 +1093,9 @@ async def serve(cluster: Cluster, host: str = "127.0.0.1",
     try:
         while True:
             await asyncio.sleep(3600)
+    # lint: cancel-safety-ok ctrl-c/cancel IS the shutdown signal for
+    # the serve park; swallowing it hands control to the finally's
+    # graceful teardown (scrub stop + runner cleanup) before exit
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:

@@ -389,6 +389,9 @@ async def serve_workers(cluster, host: str, port: int, workers: int,
         # bounded-sleep loop); resolves on ctrl-c cancellation exactly
         # like single-process serve's sleep loop
         await sup.wait()
+    # lint: cancel-safety-ok ctrl-c/cancel IS the shutdown signal for
+    # the supervisor park; swallowing it hands control to the finally's
+    # graceful fleet teardown (sup.stop) before exit
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:

@@ -1010,6 +1010,8 @@ class LoopLagMonitor:
 
     def start(self, loop) -> None:
         self._loop = loop
+        # lint: clock-escape-ok loop lag is defined against the loop's
+        # OWN clock; under sim the virtual loop makes this virtual too
         self._expected = loop.time() + self._interval
         self._handle = loop.call_later(self._interval, self._tick)
 

@@ -35,6 +35,8 @@ def _build_library() -> str:
         src = f.read()
     tag = hashlib.sha256(src).hexdigest()[:16]
     build_dir = os.path.join(_NATIVE_DIR, "_build")
+    # lint: fsio-escape-ok native .so build cache, not storage-plane
+    # state — the crash harness never replays it
     os.makedirs(build_dir, exist_ok=True)
     lib_path = os.path.join(build_dir, f"libcbgf-{tag}.so")
     if os.path.exists(lib_path):
@@ -52,6 +54,8 @@ def _build_library() -> str:
     for cmd in attempts:
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            # lint: fsio-escape-ok build-cache publish; worst case on a
+            # crash is a rebuild, never storage-plane corruption
             os.replace(tmp_path, lib_path)
             return lib_path
         except (subprocess.SubprocessError, OSError) as err:
@@ -59,6 +63,7 @@ def _build_library() -> str:
         finally:
             if os.path.exists(tmp_path):
                 try:
+                    # lint: fsio-escape-ok build temp cleanup only
                     os.remove(tmp_path)
                 except OSError:
                     pass

@@ -295,7 +295,10 @@ class HostPipeline:
     def _run_job(self, job: _Job) -> None:
         if not job._claim():
             return  # a racing claimant (shutdown rescue) already ran it
+        # lint: clock-escape-ok real worker-thread stage profiling;
+        # real thread work has zero virtual width under sim
         t0 = time.perf_counter()
+        # lint: clock-escape-ok trace spans timestamp real host work
         t0_mono = time.monotonic() if job.trace is not None else 0.0
         try:
             job.result = job.fn()
@@ -304,6 +307,7 @@ class HostPipeline:
         except BaseException as err:
             job.error = err
         finally:
+            # lint: clock-escape-ok real worker-thread stage profiling
             dt = time.perf_counter() - t0
             with self._lock:
                 st = self._stages.setdefault(job.stage, [0, 0.0, 0])
@@ -583,8 +587,11 @@ class HostPipeline:
         nothing idle).  Already-queued jobs are drained inline so no
         waiter is abandoned."""
         self._shutdown.set()
+        # lint: clock-escape-ok join deadline bounds REAL threads at
+        # shutdown — virtual time cannot advance a parked OS thread
         deadline = time.monotonic() + timeout
         for w in self._workers:
+            # lint: clock-escape-ok same real join deadline
             w.join(max(0.0, deadline - time.monotonic()))
         while True:
             try:

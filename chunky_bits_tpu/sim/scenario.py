@@ -802,6 +802,11 @@ async def _thundering_herd(env: ScenarioEnv) -> None:
     finally:
         for task in readers:
             task.cancel()
+        # cancel() only REQUESTS: when the gather above fails, the
+        # surviving readers are still mid-read — without this reap
+        # their teardown (hedge latency samples, budget refunds) races
+        # into the healthy window below and into the determinism trace
+        await asyncio.gather(*readers, return_exceptions=True)
     node.set_state(fabric_mod.HEALTHY)
     env.event("herd_end")
     env.fault_end(grace_s=30.0)

@@ -1051,13 +1051,16 @@ def test_cli_list_rules_names_every_rule_grouped_by_family():
     assert proc.returncode == 0
     for rid in ("CB101", "CB102", "CB103", "CB104", "CB105", "CB106",
                 "CB107", "CB108", "CB109",
-                "CB201", "CB202", "CB203", "CB204", "CB205"):
+                "CB201", "CB202", "CB203", "CB204", "CB205",
+                "CB301", "CB302", "CB303", "CB304", "CB305"):
         assert rid in proc.stdout
     # family grouping with one-line hazard descriptions
     assert "CB1xx — " in proc.stdout
     assert "CB2xx — " in proc.stdout
+    assert "CB3xx — " in proc.stdout
     assert proc.stdout.index("CB1xx") < proc.stdout.index("CB101")
     assert proc.stdout.index("CB2xx") < proc.stdout.index("CB201")
+    assert proc.stdout.index("CB3xx") < proc.stdout.index("CB301")
 
 
 def test_cli_select_family_prefix():
@@ -1104,3 +1107,691 @@ def test_cli_json_reports_rule_family(tmp_path):
     payload = json.loads(proc.stdout)
     assert [v["rule_family"] for v in payload["new"]] == ["CB2xx"]
     assert payload["new"][0]["rule"] == "CB203"
+
+
+# ---- CB3xx whole-program reachability family ----
+
+def run_tree(tmp_path: Path, files: dict, select: tuple = ()):
+    """Lint a multi-file fixture tree (the CB3xx rules are
+    interprocedural: roots and flagged sites live in different
+    modules)."""
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source), encoding="utf-8")
+    ruleset = [r for r in rules.ALL_RULES
+               if not select or r.id in select]
+    violations, errors = core.run_analysis(tmp_path, ruleset)
+    assert not errors, errors
+    return violations
+
+
+# -- CB301 fsio-escape --
+
+def test_fsio_escape_flags_reachable_offseam_helper(tmp_path):
+    """The hole CB109 cannot see: os.replace extracted into a utils/
+    helper that a durability root still reaches cross-module."""
+    vs = run_tree(tmp_path, {
+        "file/slab.py": """
+            from utils import misc
+
+            class SlabStore:
+                def append(self, a, b):
+                    misc.swap(a, b)
+        """,
+        "utils/misc.py": """
+            import os
+
+            def swap(a, b):
+                os.replace(a, b)
+        """,
+    }, select=("CB301",))
+    assert [(v.rule, v.path) for v in vs] == \
+        [("CB301", "utils/misc.py")]
+    assert "os.replace()" in vs[0].message
+    assert "crash harness" in vs[0].message
+
+
+def test_fsio_escape_passes_unreachable_and_governed(tmp_path):
+    # same off-seam helper, but nothing on a durability path calls it
+    assert run_tree(tmp_path, {
+        "file/slab.py": """
+            class SlabStore:
+                def append(self, a, b):
+                    return (a, b)
+        """,
+        "utils/misc.py": """
+            import os
+
+            def swap(a, b):
+                os.replace(a, b)
+        """,
+    }, select=("CB301",)) == []
+    # ops inside CB109's own path scope are CB109's findings, not a
+    # second CB301 on the same line
+    assert run_tree(tmp_path, {
+        "file/slab.py": """
+            import os
+
+            class SlabStore:
+                def append(self, a, b):
+                    os.replace(a, b)
+        """,
+    }, select=("CB301",)) == []
+
+
+def test_fsio_escape_write_mode_open_and_suppression(tmp_path):
+    files = {
+        "file/slab.py": """
+            from utils import misc
+
+            class SlabStore:
+                def compact(self, p):
+                    misc.dump(p)
+                    misc.load(p)
+        """,
+        "utils/misc.py": """
+            def dump(p):
+                with open(p, "wb") as f:
+                    f.write(b"x")
+
+            def load(p):
+                with open(p, "rb") as f:
+                    return f.read()
+        """,
+    }
+    vs = run_tree(tmp_path, files, select=("CB301",))
+    # write-mode open flagged, read-mode open not
+    assert [v.rule for v in vs] == ["CB301"]
+    assert "write-mode open" in vs[0].message
+    files["utils/misc.py"] = """
+        def dump(p):
+            # lint: fsio-escape-ok fixture-sanctioned off-seam write
+            with open(p, "wb") as f:
+                f.write(b"x")
+
+        def load(p):
+            with open(p, "rb") as f:
+                return f.read()
+    """
+    assert run_tree(tmp_path / "sup", files, select=("CB301",)) == []
+
+
+# -- CB302 clock-escape --
+
+def test_clock_escape_flags_reachable_wall_clock(tmp_path):
+    vs = run_tree(tmp_path, {
+        "sim/scenario.py": """
+            from parallel import util
+
+            async def drive(env):
+                return util.step()
+        """,
+        "parallel/util.py": """
+            import time
+
+            def step():
+                return time.monotonic()
+        """,
+    }, select=("CB302",))
+    assert [(v.rule, v.path) for v in vs] == \
+        [("CB302", "parallel/util.py")]
+    assert "time.monotonic()" in vs[0].message
+    assert "virtual-time" in vs[0].message
+
+
+def test_clock_escape_passes_unreachable_and_governed(tmp_path):
+    # wall clock in a function no scenario reaches: not this rule's
+    # business (and outside CB108's path scope, nobody else's either)
+    assert run_tree(tmp_path, {
+        "sim/scenario.py": """
+            async def drive(env):
+                return None
+        """,
+        "parallel/util.py": """
+            import time
+
+            def step():
+                return time.monotonic()
+        """,
+    }, select=("CB302",)) == []
+    # reachable wall clock inside CB108's path scope: CB108's finding,
+    # never a double report
+    assert run_tree(tmp_path, {
+        "sim/scenario.py": """
+            from cluster import util
+
+            async def drive(env):
+                return util.step()
+        """,
+        "cluster/util.py": """
+            import time
+
+            def step():
+                return time.monotonic()
+        """,
+    }, select=("CB302",)) == []
+
+
+def test_clock_escape_flags_loop_time_and_alias_imports(tmp_path):
+    vs = run_tree(tmp_path, {
+        "sim/scenario.py": """
+            from parallel import util
+
+            async def drive(loop):
+                return util.lag(loop) + util.stamp()
+        """,
+        "parallel/util.py": """
+            from time import monotonic as mono
+
+            def lag(loop):
+                return loop.time()
+
+            def stamp():
+                return mono()
+        """,
+    }, select=("CB302",))
+    assert sorted(v.message.split(" in ")[0] for v in vs) == \
+        ["direct loop.time() (loop.time)", "direct time.monotonic"]
+
+
+# -- CB303 cancel-safety --
+
+def test_cancel_safety_flags_swallowed_cancelled(tmp_path):
+    vs = run_snippet(tmp_path, "file/x.py", """
+        import asyncio
+
+        async def run(q):
+            try:
+                return await q.get()
+            except asyncio.CancelledError:
+                return None
+    """, select=("CB303",))
+    assert [v.rule for v in vs] == ["CB303"]
+    assert "swallows CancelledError" in vs[0].message
+
+
+def test_cancel_safety_flags_bare_and_base_exception(tmp_path):
+    vs = run_snippet(tmp_path, "file/x.py", """
+        import asyncio
+
+        async def a(q):
+            try:
+                return await q.get()
+            except BaseException:
+                return None
+
+        async def b(q):
+            try:
+                return await q.get()
+            except:
+                return None
+    """, select=("CB303",))
+    assert len(vs) == 2
+    assert "bare except" in vs[1].message
+
+
+def test_cancel_safety_passes_reraise_and_child_reap(tmp_path):
+    assert run_snippet(tmp_path, "file/x.py", """
+        import asyncio
+
+        async def run(q):
+            try:
+                return await q.get()
+            except asyncio.CancelledError:
+                raise
+
+        async def stop(task):
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+    """, select=("CB303",)) == []
+
+
+def test_cancel_safety_flags_cancel_without_await(tmp_path):
+    vs = run_snippet(tmp_path, "gateway/x.py", """
+        import asyncio
+
+        async def abort(task):
+            task.cancel()
+            return True
+    """, select=("CB303",))
+    assert [v.rule for v in vs] == ["CB303"]
+    assert "never awaited" in vs[0].message
+
+
+def test_cancel_safety_herd_shape_regression(tmp_path):
+    """The sim/scenario.py thundering-herd bug class: a finally that
+    cancels the reader fleet but never awaits it leaves tasks
+    mid-teardown when the function moves on.  Must-flag as written,
+    must-pass once the reap gather is added (the shipped fix)."""
+    vs = run_snippet(tmp_path, "sim/x.py", """
+        import asyncio
+
+        async def herd(make):
+            tasks = [asyncio.ensure_future(make()) for _ in range(3)]
+            try:
+                await asyncio.gather(*tasks)
+            finally:
+                for t in tasks:
+                    t.cancel()
+    """, select=("CB303",))
+    assert [v.rule for v in vs] == ["CB303"]
+    assert run_snippet(tmp_path / "fixed", "sim/x.py", """
+        import asyncio
+
+        async def herd(make):
+            tasks = [asyncio.ensure_future(make()) for _ in range(3)]
+            try:
+                await asyncio.gather(*tasks)
+            finally:
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+    """, select=("CB303",)) == []
+
+
+def test_cancel_safety_passes_tuple_target_and_handles(tmp_path):
+    """The fetch_hedged shape: cancel inside `for task, meta in
+    d.items():` is observed by gathering the dict; TimerHandle.cancel()
+    completes synchronously and needs no await."""
+    assert run_snippet(tmp_path, "file/x.py", """
+        import asyncio
+
+        async def reap(pending):
+            for task, (loc, t0) in pending.items():
+                task.cancel()
+            await asyncio.gather(*pending, return_exceptions=True)
+
+        async def disarm(handle):
+            handle.cancel()
+    """, select=("CB303",)) == []
+
+
+def test_cancel_safety_flags_publish_window_await(tmp_path):
+    vs = run_snippet(tmp_path, "cluster/x.py", """
+        import os
+
+        async def publish(f, audit, tmp, dst):
+            await f.write(b"x")
+            await audit.notify()
+            os.replace(tmp, dst)
+    """, select=("CB303",))
+    assert [v.rule for v in vs] == ["CB303"]
+    assert "strands the temp file" in vs[0].message
+
+
+def test_cancel_safety_passes_shielded_and_tight_windows(tmp_path):
+    assert run_snippet(tmp_path, "cluster/x.py", """
+        import asyncio
+        import os
+
+        async def publish(f, audit, tmp, dst):
+            await f.write(b"x")
+            await asyncio.shield(audit.notify())
+            os.replace(tmp, dst)
+
+        async def publish_tight(f, tmp, dst):
+            await f.write(b"x")
+            os.replace(tmp, dst)
+    """, select=("CB303",)) == []
+
+
+# -- CB304 sim-purity --
+
+def test_sim_purity_flags_production_imports(tmp_path):
+    for src in (
+        "from chunky_bits_tpu.sim import fabric\n",
+        "import chunky_bits_tpu.sim.fabric\n",
+        "from chunky_bits_tpu import sim\n",
+        # lazy in-function import: invisible to the runtime pin until
+        # the branch executes, still a static finding here
+        "def f():\n    from chunky_bits_tpu.sim import loop\n"
+        "    return loop\n",
+    ):
+        vs = run_snippet(tmp_path / str(abs(hash(src)) % 997),
+                         "file/x.py", src, select=("CB304",))
+        assert [v.rule for v in vs] == ["CB304"], src
+        assert "inverts the sim seam" in vs[0].message
+
+
+def test_sim_purity_passes_sim_plane_and_lookalikes(tmp_path):
+    # the simulator importing itself is the point, not a violation
+    assert run_snippet(tmp_path, "sim/x.py", """
+        from chunky_bits_tpu.sim import fabric
+    """, select=("CB304",)) == []
+    # 'sim' must match as a dotted segment, not a substring
+    assert run_snippet(tmp_path / "b", "file/x.py", """
+        import simpy
+        from simulation import engine
+    """, select=("CB304",)) == []
+
+
+def test_sim_purity_suppression_on_sanctioned_inversion(tmp_path):
+    assert run_snippet(tmp_path, "file/x.py", """
+        def resolve(target):
+            # lint: sim-purity-ok fixture-sanctioned lazy sim branch
+            from chunky_bits_tpu.sim import fabric
+            return fabric.resolve(target)
+    """, select=("CB304",)) == []
+
+
+# -- CB305 label-flow --
+
+def test_label_flow_flags_fstring_at_call_site(tmp_path):
+    vs = run_snippet(tmp_path, "obs/x.py", """
+        COUNTER = object()
+
+        def record(kind):
+            COUNTER.labels(kind)
+
+        def handler(path):
+            record(f"get:{path}")
+    """, select=("CB305",))
+    assert [v.rule for v in vs] == ["CB305"]
+    assert "'kind'" in vs[0].message
+    # the finding lands at the CALL SITE, where the clamp belongs
+    assert "record(" in vs[0].snippet
+
+
+def test_label_flow_passes_closed_args_and_flags_kwargs(tmp_path):
+    assert run_snippet(tmp_path, "obs/x.py", """
+        COUNTER = object()
+
+        def record(kind):
+            COUNTER.labels(kind)
+
+        def handler():
+            record("get")
+    """, select=("CB305",)) == []
+    vs = run_snippet(tmp_path / "kw", "obs/x.py", """
+        COUNTER = object()
+
+        class Rec:
+            def record(self, kind):
+                COUNTER.labels(kind)
+
+        def handler(rec, path):
+            rec.record(kind="get:" + path)
+    """, select=("CB305",))
+    assert [v.rule for v in vs] == ["CB305"]
+
+
+# -- call-graph precision units --
+
+def _graph(tmp_path: Path, files: dict):
+    from chunky_bits_tpu.analysis import callgraph
+
+    sfs = []
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        src = textwrap.dedent(source)
+        path.write_text(src, encoding="utf-8")
+        sfs.append(core.SourceFile(path, rel, src))
+    return callgraph.build_call_graph(sfs)
+
+
+def test_callgraph_self_methods_and_decorators(tmp_path):
+    g = _graph(tmp_path, {"x.py": """
+        def deco(fn):
+            def wrapper():
+                return fn()
+            return wrapper
+
+        class C:
+            @deco
+            def a(self):
+                self.b()
+
+            def b(self):
+                pass
+    """})
+    assert ("x.py", "C.b") in g.edges[("x.py", "C.a")]
+    # calling the decorated method actually runs the decorator's
+    # machinery: the decorator is linked to its decoratee
+    assert ("x.py", "C.a") in g.edges[("x.py", "deco")]
+
+
+def test_callgraph_partial_lambda_and_to_thread_roots(tmp_path):
+    g = _graph(tmp_path, {"x.py": """
+        import asyncio
+        import functools
+
+        def helper(n):
+            return n
+
+        def lam_helper():
+            return helper(2)
+
+        async def spawn():
+            await asyncio.to_thread(functools.partial(helper, 1))
+            await asyncio.to_thread(lambda: lam_helper())
+    """})
+    assert ("x.py", "helper") in g.roots
+    reach = g.worker_reachable()
+    # the lambda is itself a root and its body's calls are followed
+    assert ("x.py", "lam_helper") in reach
+    assert ("x.py", "helper") in reach
+
+
+def test_callgraph_counts_unknown_edges_for_dynamic_dispatch(tmp_path):
+    g = _graph(tmp_path, {"x.py": """
+        def f(cb, table):
+            cb()
+            table["k"]()
+            return f()()
+    """})
+    assert g.unknown_edges[("x.py", "f")] == 3
+
+
+def test_callgraph_cross_module_import_resolution(tmp_path):
+    g = _graph(tmp_path, {
+        "a/one.py": """
+            from b import two
+
+            def caller():
+                two.target()
+        """,
+        "b/two.py": """
+            def target():
+                pass
+        """,
+    })
+    assert ("b/two.py", "target") in g.edges[("a/one.py", "caller")]
+
+
+def test_callgraph_async_defs_never_run_on_workers(tmp_path):
+    """An async def handed to a thread only builds a coroutine object
+    there — it must neither seed the worker closure nor be entered by
+    it (the FilePart.read false-positive class)."""
+    g = _graph(tmp_path, {"x.py": """
+        import asyncio
+
+        async def aread():
+            return touched()
+
+        def touched():
+            return 1
+
+        def sync_root():
+            asyncio.to_thread(aread)
+
+        async def spawn():
+            await asyncio.to_thread(sync_root)
+    """})
+    assert ("x.py", "aread") not in g.roots
+    reach = g.worker_reachable()
+    assert ("x.py", "sync_root") in reach
+    assert ("x.py", "aread") not in reach
+    assert ("x.py", "touched") not in reach
+    # general reachability still follows the handoff: the body DOES run
+    # (on a loop), so seam rules must keep seeing it
+    assert ("x.py", "aread") in g.reachable({("x.py", "sync_root")})
+
+
+def test_callgraph_threadsafe_crossing_stops_worker_closure(tmp_path):
+    """The HostPipeline bridge/resolve shape: a callable handed back
+    through call_soon_threadsafe runs ON the loop — worker-ness must
+    not flow through the sanctioned crossing, while plain reachability
+    still does."""
+    g = _graph(tmp_path, {"x.py": """
+        import threading
+
+        def start(loop, fut):
+            def bridge():
+                def resolve():
+                    fut.set_result(1)
+                loop.call_soon_threadsafe(resolve)
+            threading.Thread(target=bridge, daemon=True).start()
+    """})
+    key_bridge = ("x.py", "start.bridge")
+    key_resolve = ("x.py", "start.bridge.resolve")
+    assert key_bridge in g.roots
+    assert (key_bridge, key_resolve) in g.loop_edges
+    reach = g.worker_reachable()
+    assert key_bridge in reach
+    assert key_resolve not in reach
+    assert key_resolve in g.reachable({key_bridge})
+
+
+# -- scoped fingerprints + baseline migration --
+
+def test_fingerprint_survives_duplicate_line_churn(tmp_path):
+    """The same offending line added in ANOTHER function must not shift
+    the first finding's fingerprint (the failure mode of file-wide
+    occurrence counting)."""
+    before = run_snippet(tmp_path, "ops/x.py", """
+        import os
+
+        def f():
+            return os.environ.get("CHUNKY_BITS_TPU_FOO")
+    """, select=("CB102",))
+    after = run_snippet(tmp_path / "b", "ops/x.py", """
+        import os
+
+        def earlier():
+            return os.environ.get("CHUNKY_BITS_TPU_FOO")
+
+        def f():
+            return os.environ.get("CHUNKY_BITS_TPU_FOO")
+    """, select=("CB102",))
+    assert len(before) == 1 and len(after) == 2
+    f_after = [v for v in after if v.scope == "f"]
+    assert [v.fingerprint for v in f_after] == \
+        [before[0].fingerprint]
+
+
+def test_baseline_legacy_fingerprints_still_match(tmp_path):
+    """One-shot migration: a pre-scope baseline entry (written before
+    fingerprints carried the enclosing qualname) keeps matching through
+    Violation.keys() until the next --write-baseline rewrites it."""
+    vs = _sample_violations(tmp_path)
+    legacy_entries = "".join(
+        f'[[violation]]\nrule = "{v.rule}"\npath = "{v.path}"\n'
+        f'fingerprint = "{v.legacy_fingerprint}"\n'
+        for v in vs)
+    baseline_path = tmp_path / "legacy.toml"
+    baseline_path.write_text(legacy_entries, encoding="utf-8")
+    accepted = core.load_baseline(baseline_path)
+    assert all(set(v.keys()) & accepted for v in vs)
+    # and the scoped spelling differs, so the dual key is load-bearing
+    assert all(v.key() not in accepted for v in vs)
+
+
+def test_write_baseline_records_scope(tmp_path):
+    vs = _sample_violations(tmp_path)
+    baseline_path = tmp_path / "b.toml"
+    core.write_baseline(baseline_path, vs)
+    text = baseline_path.read_text(encoding="utf-8")
+    assert 'scope = "f"' in text and 'scope = "g"' in text
+
+
+# -- CLI: --explain / --format github / --graph-stats --
+
+def test_cli_explain_rule_and_family():
+    proc = _run_cli("--explain", "CB303")
+    assert proc.returncode == 0
+    assert "cancel-safety" in proc.stdout
+    assert "child-reap" in proc.stdout  # the docstring, not one line
+    proc = _run_cli("--explain", "CB3")
+    assert proc.returncode == 0
+    for rid in ("CB301", "CB302", "CB303", "CB304", "CB305"):
+        assert rid in proc.stdout
+    proc = _run_cli("--explain", "fsio-escape")
+    assert proc.returncode == 0 and "CB301" in proc.stdout
+    proc = _run_cli("--explain", "CB999")
+    assert proc.returncode == 2
+
+
+def test_cli_format_github_annotations(tmp_path):
+    scratch = tmp_path / "pkg"
+    (scratch / "file").mkdir(parents=True)
+    (scratch / "file" / "x.py").write_text(
+        "import asyncio\n\n\nasync def run(q):\n    try:\n"
+        "        return await q.get()\n"
+        "    except asyncio.CancelledError:\n        return None\n",
+        encoding="utf-8")
+    proc = _run_cli("--root", str(scratch), "--no-baseline",
+                    "--format", "github")
+    assert proc.returncode == 1
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("::error")][0]
+    assert "file=file/x.py" in line
+    assert "title=CB303 [cancel-safety]" in line
+    # messages are single annotation lines whatever they contain
+    assert "\n" not in line and "%0A" not in line.split("::")[0]
+
+
+def test_cli_graph_stats_text_and_json():
+    import json
+
+    proc = _run_cli("--select", "CB3", "--graph-stats")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "graph:" in proc.stdout and "worker roots" in proc.stdout
+    proc = _run_cli("--select", "CB3", "--graph-stats", "--json")
+    assert proc.returncode == 0
+    payload = json.loads(proc.stdout)
+    assert payload["graph"]["functions"] > 1000
+    assert payload["graph"]["edges"] > payload["graph"]["functions"]
+    assert 0 < payload["graph"]["worker_roots"] < 200
+
+
+def test_cli_select_cb3_exits_zero_on_shipped_tree():
+    """The ISSUE's acceptance invocation."""
+    proc = _run_cli("--select", "CB3")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- analyzer stays stdlib-only and inside the CI runtime budget --
+
+def test_analyzer_imports_no_heavy_deps():
+    """The linter must run with the device tunnel down: a full
+    in-process analysis may not drag in jax/numpy/aiohttp."""
+    code = (
+        "import sys\n"
+        "from pathlib import Path\n"
+        "from chunky_bits_tpu.analysis import core, rules\n"
+        "core.run_analysis(Path('chunky_bits_tpu'), rules.ALL_RULES)\n"
+        "bad = [m for m in ('jax', 'numpy', 'aiohttp')\n"
+        "       if m in sys.modules]\n"
+        "assert not bad, bad\n")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, cwd=str(PKG_ROOT.parent))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_analyzer_runtime_budget():
+    """Full run (all families, graph build included) stays under the
+    CI budget — the whole-program pass must not make check.sh the slow
+    leg."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    proc = _run_cli("--graph-stats")
+    elapsed = _time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert elapsed < 15.0, f"analysis took {elapsed:.1f}s"

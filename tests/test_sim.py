@@ -116,7 +116,13 @@ def test_sim_run_rejects_nested_loop():
 def test_production_imports_nothing_from_sim():
     """The acceptance criterion, checked in a clean interpreter: the
     cluster/file/gateway planes import with zero sim modules loaded
-    (the ``sim:`` Location branches are lazy, like ``slab:``)."""
+    (the ``sim:`` Location branches are lazy, like ``slab:``).
+
+    Deliberately kept ALONGSIDE lint rule CB304 (sim-purity), not
+    replaced by it: this pin proves the *runtime default import
+    closure* is sim-free (catching dynamic/importlib paths static
+    analysis cannot see), while CB304 proves it *statically* including
+    lazy in-function imports this subprocess never executes."""
     code = (
         "import sys\n"
         "import chunky_bits_tpu.cluster\n"
