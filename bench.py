@@ -1817,6 +1817,322 @@ def bench_slab_store(argv=()) -> None:
         sys.exit(3)
 
 
+def bench_meta_log(argv=()) -> None:
+    """BASELINE.md config 18: indexed meta-log vs file-per-ref
+    metadata-plane A/B (CPU-only, no device, no watchdog).  The same
+    namespace of d=3 p=2-shaped file references, laid out
+    hierarchically (32 x 16 directories), is published through two
+    stores behind the same MetadataStore surface — ``type: path``
+    (one file per ref, the reference's shape) and ``type: meta-log``
+    (cluster/meta_log.py: append-only ref log + journal-committed
+    index) — and every namespace-scale operation the PR moved onto
+    the index is timed through the surface each store actually
+    serves:
+
+    - recursive listing (the walk ``Cluster.list_files`` callers pay:
+      one ``list()`` round-trip per directory, vs ONE index scan via
+      ``list_files_recursive``),
+    - prefix scan (one subtree),
+    - scrub-pass metadata cost (the priority pre-scan: the legacy
+      store must walk the namespace AND read+parse every ref before
+      it can order the pass — ``ScrubDaemon._namespace_refs`` — while
+      the meta-log scores the whole namespace from one index scan of
+      publish-time node keys, ``namespace_nodes``, reading zero ref
+      bytes: ``_index_prescan``),
+    - GC live-hash candidate walk (the ``find-unused-hashes`` liveness
+      set: per-file ref reads + hash extraction vs a pure index scan
+      of publish-time hash projections, ``namespace_hashes``),
+    - verify-walk fetch (meta-log only, informational: one batched
+      ``namespace_snapshot`` — the grouped-read cost the paged verify
+      walk pays across a whole pass),
+    - cold-start index build (meta-log only: journal replay into a
+      fresh index — the restart cost the path store does not have but
+      also cannot amortize).
+
+    Ref payloads are asserted byte-identical across the stores in-run
+    (sampled every ~97th name; the golden ``meta_log_placement``
+    fixture pins the same property for real cluster writes).
+
+    Flags: ``--objects N`` (default 10000), ``--smoke`` (CI-scale:
+    1000 objects).
+
+    Failure contract (tests/test_bench_outage.py): ANY failure still
+    emits exactly one parseable JSON line and exits 3."""
+    import asyncio
+    import contextlib
+    import hashlib
+    import os
+    import tempfile
+
+    argv = list(argv)
+
+    def flag(name, default, cast):
+        if name in argv:
+            return cast(argv[argv.index(name) + 1])
+        return default
+
+    metric = "meta_log_scrub_meta_speedup_10k"
+    try:
+        objects = flag("--objects", 10_000, int)
+        if "--smoke" in argv:
+            objects = min(objects, 1_000)
+        if objects <= 0:
+            raise ValueError("--objects must be positive")
+
+        from chunky_bits_tpu.cluster.meta_log import MetadataLog, MetaLogStore
+        from chunky_bits_tpu.cluster.metadata import (MetadataFormat,
+                                                      MetadataPath)
+
+        def name_of(i: int) -> str:
+            return f"ns{i % 32:02d}/g{(i // 32) % 16:02d}/o{i:06d}"
+
+        def ref_obj(i: int) -> dict:
+            """One d=3 p=2 single-part ref in the exact to_obj layout
+            a real write produces (see the golden fixtures), hashes
+            deterministic per object."""
+
+            def chunk(j: int) -> dict:
+                digest = hashlib.sha256(f"{i}:{j}".encode()).hexdigest()
+                return {"sha256": digest,
+                        "locations": [f"d{j}/sha256-{digest}"]}
+
+            return {"length": 12_288,
+                    "parts": [{"chunksize": 4096,
+                               "data": [chunk(j) for j in range(3)],
+                               "parity": [chunk(j) for j in (3, 4)]}]}
+
+        refs = [ref_obj(i) for i in range(objects)]
+        names = [name_of(i) for i in range(objects)]
+
+        async def walk_paths(store) -> list:
+            """The legacy recursive file enumeration: one ``list()``
+            round-trip per directory (ScrubDaemon._list_file_paths's
+            shape)."""
+            out, stack = [], ["."]
+            while stack:
+                path = stack.pop()
+                for entry in await store.list(path):
+                    if str(entry.path) in (".", path):
+                        continue
+                    if entry.is_directory():
+                        stack.append(entry.path)
+                    elif entry.is_file():
+                        out.append(entry.path)
+            return out
+
+        def extract_hashes(obj, into: set) -> None:
+            # display form, matching the index projection's str(hash)
+            for part in obj["parts"]:
+                for chunk in part["data"] + part["parity"]:
+                    into.add("sha256-" + chunk["sha256"])
+
+        async def run_leg(root: str, kind: str) -> dict:
+            meta = os.path.join(root, "meta")
+            os.makedirs(meta, exist_ok=True)
+            # json-strict: the one format that parses via json.loads —
+            # keeps the shared parse cost from drowning the I/O delta
+            # either leg (both legs pay it identically).  Constructed
+            # directly, NOT via metadata_from_obj: the A/B must stay
+            # path-vs-log even when $CHUNKY_BITS_TPU_METADATA_KIND
+            # would rebuild the path leg fleet-wide.
+            fmt = MetadataFormat("json-strict")
+            if kind == "path":
+                store: object = MetadataPath(path=meta, format=fmt)
+            else:
+                store = MetadataLog(path=meta, format=fmt)
+            t0 = time.perf_counter()
+            for name, obj in zip(names, refs):
+                await store.write(name, obj)
+            put_s = time.perf_counter() - t0
+            recursive = getattr(store, "list_files_recursive", None)
+            t0 = time.perf_counter()
+            if recursive is not None:
+                files = await recursive("")
+            else:
+                files = await walk_paths(store)
+            list_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            if recursive is not None:
+                subtree = await recursive("ns07")
+            else:
+                out, stack = [], ["ns07"]
+                while stack:
+                    path = stack.pop()
+                    for entry in await store.list(path):
+                        if str(entry.path) in (".", path):
+                            continue
+                        if entry.is_directory():
+                            stack.append(entry.path)
+                        elif entry.is_file():
+                            out.append(entry.path)
+                subtree = out
+            prefix_s = time.perf_counter() - t0
+            # scrub-pass metadata cost — the priority pre-scan: the
+            # legacy store cannot order a pass without walking the
+            # namespace and reading+parsing EVERY ref (the refs it
+            # then scrubs come from this same snapshot); the meta-log
+            # scores the whole namespace from one index scan of
+            # publish-time node keys, zero ref reads
+            # (scrub._index_prescan's shape, here against an empty
+            # degraded set — the set test costs the same either way)
+            index_nodes = getattr(store, "namespace_nodes", None)
+            degraded: frozenset = frozenset()
+            t0 = time.perf_counter()
+            if index_nodes is not None:
+                rows = await index_nodes()
+                assert rows is not None, "index projection missing"
+                scanned = [
+                    (0 if degraded and any(k in degraded for k in nk)
+                     else 2, name)
+                    for name, nk in rows]
+            else:
+                scanned = [(p, await store.read(p))
+                           for p in await walk_paths(store)]
+            scrub_s = time.perf_counter() - t0
+            # GC live-hash walk (find-unused-hashes' liveness set): a
+            # separate pass with its own listing (GC runs in its own
+            # process per batch) — per-file ref reads + extraction on
+            # the legacy store, a pure index scan of publish-time hash
+            # projections on the meta-log (_get_hashes_snapshot's
+            # shape)
+            live: set = set()
+            index_hashes = getattr(store, "namespace_hashes", None)
+            t0 = time.perf_counter()
+            if index_hashes is not None:
+                hrows = await index_hashes()
+                assert hrows is not None, "hash projection missing"
+                for _name, hs in hrows:
+                    live.update(hs)
+            else:
+                for p in await walk_paths(store):
+                    extract_hashes(await store.read(p), live)
+            gc_s = time.perf_counter() - t0
+            snapshot_ms = 0.0
+            cold_ms = 0.0
+            if kind == "meta-log":
+                # verify-walk fetch, informational: one batched
+                # snapshot = the grouped-read+parse cost the paged
+                # verify walk spreads across a whole pass
+                t0 = time.perf_counter()
+                fetched = await store.namespace_snapshot()
+                snapshot_ms = (time.perf_counter() - t0) * 1000.0
+                assert len(fetched) == objects, \
+                    f"snapshot {len(fetched)} != {objects}"
+                del fetched
+                # cold-start index build: journal replay into a FRESH
+                # store (deliberately not get_store's warm instance)
+                t0 = time.perf_counter()
+                cold = MetaLogStore(meta)
+                n_cold = len(cold.live_names())
+                cold_ms = (time.perf_counter() - t0) * 1000.0
+                assert n_cold == objects, \
+                    f"cold index {n_cold} != {objects}"
+            assert len(files) == objects, \
+                f"{kind} listed {len(files)} != {objects}"
+            assert len(scanned) == objects, \
+                f"{kind} scanned {len(scanned)} != {objects}"
+            assert len(subtree) == sum(
+                1 for n in names if n.startswith("ns07/")), \
+                f"{kind} prefix scan miscounted"
+            return {"put_ops": objects / put_s,
+                    "list_ms": list_s * 1000.0,
+                    "prefix_ms": prefix_s * 1000.0,
+                    "scrub_ms": scrub_s * 1000.0,
+                    "gc_ms": gc_s * 1000.0,
+                    "snapshot_ms": snapshot_ms,
+                    "cold_ms": cold_ms,
+                    "live_hashes": live,
+                    "meta_dir": meta}
+
+        async def run() -> tuple:
+            with contextlib.ExitStack() as stack:
+                path_root = stack.enter_context(
+                    tempfile.TemporaryDirectory())
+                log_root = stack.enter_context(
+                    tempfile.TemporaryDirectory())
+                path_leg = await run_leg(path_root, "path")
+                log_leg = await run_leg(log_root, "meta-log")
+                # byte identity across stores, asserted in-run on a
+                # sample (every ~97th name, first and last included)
+                log_store = MetaLogStore(log_leg["meta_dir"])
+                step = max(1, objects // 97)
+                compared = 0
+                for i in list(range(0, objects, step)) + [objects - 1]:
+                    fpath = os.path.join(
+                        path_leg["meta_dir"],
+                        *names[i].split("/"))
+                    with open(fpath, "rb") as f:
+                        path_bytes = f.read()
+                    log_bytes = log_store.read_bytes(names[i])
+                    assert path_bytes == log_bytes, \
+                        f"ref {names[i]} differs across stores"
+                    compared += 1
+            return path_leg, log_leg, compared
+
+        path_leg, log_leg, compared = asyncio.run(run())
+        # full SET equality: the index projection and the parsed refs
+        # must agree on every live hash, or GC would delete live data
+        assert path_leg["live_hashes"] == log_leg["live_hashes"], \
+            "GC liveness sets differ across stores"
+
+        def speedup(key: str) -> float:
+            return (path_leg[key] / log_leg[key]
+                    if log_leg[key] > 0 else 0.0)
+
+        list_ab = speedup("list_ms")
+        prefix_ab = speedup("prefix_ms")
+        scrub_ab = speedup("scrub_ms")
+        gc_ab = speedup("gc_ms")
+        print(f"# config 18: {objects} refs over 32x16 dirs — PUT "
+              f"path/log {path_leg['put_ops']:.0f}/"
+              f"{log_leg['put_ops']:.0f} obj/s | list "
+              f"{path_leg['list_ms']:.1f} vs {log_leg['list_ms']:.1f} "
+              f"ms ({list_ab:.1f}x) | prefix "
+              f"{path_leg['prefix_ms']:.1f} vs "
+              f"{log_leg['prefix_ms']:.1f} ms ({prefix_ab:.1f}x) | "
+              f"scrub-meta {path_leg['scrub_ms']:.0f} vs "
+              f"{log_leg['scrub_ms']:.0f} ms ({scrub_ab:.1f}x) | GC "
+              f"{path_leg['gc_ms']:.0f} vs {log_leg['gc_ms']:.0f} ms "
+              f"({gc_ab:.1f}x) | snapshot "
+              f"{log_leg['snapshot_ms']:.0f} ms | cold index "
+              f"{log_leg['cold_ms']:.1f} ms | {compared} refs "
+              f"byte-identical", file=sys.stderr)
+        print(json.dumps({
+            "metric": metric,
+            # the headline: how much cheaper a scrub pass's metadata
+            # side got (>= 1.0 means the index wins)
+            "value": round(scrub_ab, 2), "unit": "x",
+            "vs_baseline": round(scrub_ab, 3),
+            "objects": objects,
+            "put_path_ops": round(path_leg["put_ops"], 1),
+            "put_log_ops": round(log_leg["put_ops"], 1),
+            "list_path_ms": round(path_leg["list_ms"], 2),
+            "list_log_ms": round(log_leg["list_ms"], 2),
+            "list_speedup": round(list_ab, 2),
+            "prefix_path_ms": round(path_leg["prefix_ms"], 2),
+            "prefix_log_ms": round(log_leg["prefix_ms"], 2),
+            "prefix_speedup": round(prefix_ab, 2),
+            "scrub_meta_path_ms": round(path_leg["scrub_ms"], 2),
+            "scrub_meta_log_ms": round(log_leg["scrub_ms"], 2),
+            "scrub_meta_speedup": round(scrub_ab, 2),
+            "gc_live_path_ms": round(path_leg["gc_ms"], 2),
+            "gc_live_log_ms": round(log_leg["gc_ms"], 2),
+            "gc_live_speedup": round(gc_ab, 2),
+            "snapshot_log_ms": round(log_leg["snapshot_ms"], 2),
+            "cold_index_ms": round(log_leg["cold_ms"], 2),
+            "refs_byte_identical": compared,
+        }))
+    # lint: broad-except-ok the driver contract (ONE parseable JSON
+    # line, always) outranks the traceback; the error text carries it
+    except Exception as err:
+        print(json.dumps({
+            "metric": metric, "value": 0.0, "unit": "x",
+            "vs_baseline": 0.0,
+            "error": f"{type(err).__name__}: {err}",
+        }))
+        sys.exit(3)
+
+
 def bench_repair_bandwidth(argv=()) -> None:
     """BASELINE.md config 11: repair-bandwidth A/B (CPU-only, no
     device, no watchdog).  Many small objects are written with
@@ -3252,12 +3568,13 @@ if __name__ == "__main__":
                    "14": lambda: bench_sim_scenarios(sys.argv),
                    "15": lambda: bench_slo_detection(sys.argv),
                    "16": lambda: bench_crash_matrix(sys.argv),
-                   "17": lambda: bench_mesh_pipeline(sys.argv)}
+                   "17": lambda: bench_mesh_pipeline(sys.argv),
+                   "18": lambda: bench_meta_log(sys.argv)}
         idx = sys.argv.index("--config") + 1
         which = sys.argv[idx] if idx < len(sys.argv) else ""
         if which not in configs:
             print(f"usage: bench.py [--config "
-                  f"{{1,2,3,4,6,7,8,9,10,11,12,13,14,15,16,17}}]"
+                  f"{{1,2,3,4,6,7,8,9,10,11,12,13,14,15,16,17,18}}]"
                   f" — the device kernel metric (configs 2+3's compute "
                   f"core) is the default no-arg run (got {which!r}); 6 "
                   f"is the hot-read cache A/B, 7 the gateway PUT ingest "
@@ -3271,7 +3588,8 @@ if __name__ == "__main__":
                   f"detection-quality + engine-off overhead suite, 16 "
                   f"the crash-consistency matrix suite (all CPU-only), "
                   f"17 the multi-device mesh backend + dispatch-"
-                  f"pipeline A/B (virtual CPU mesh by default)",
+                  f"pipeline A/B (virtual CPU mesh by default), 18 the "
+                  f"indexed meta-log vs file-per-ref metadata-plane A/B",
                   file=sys.stderr)
             sys.exit(2)
         configs[which]()

@@ -27,7 +27,8 @@ DEVICE_NET_PATHS = ("ops/", "parallel/", "gateway/", "obs/",
                     "file/chunk_cache.py",
                     "file/file_part.py", "file/slab.py",
                     "cluster/destination.py", "cluster/health.py",
-                    "cluster/scrub.py", "cluster/repair.py")
+                    "cluster/scrub.py", "cluster/repair.py",
+                    "cluster/meta_log.py")
 
 ENV_PREFIX = "CHUNKY_BITS_TPU_"
 
@@ -596,7 +597,8 @@ class FsioSeamRule(Rule):
     description = ("storage-plane durability ops go through the "
                    "file/fsio.py seam")
     paths = ("file/slab.py", "file/location.py", "cluster/metadata.py",
-             "cluster/repair.py", "cluster/scrub.py")
+             "cluster/meta_log.py", "cluster/repair.py",
+             "cluster/scrub.py")
 
     #: the os-level durability verbs the seam wraps (os.rename rides
     #: along: it is os.replace minus the overwrite guarantee)

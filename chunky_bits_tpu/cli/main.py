@@ -4,7 +4,9 @@ Mirrors src/bin/chunky-bits/main.rs: global overrides ``--config``,
 ``--chunk-size``, ``--data-chunks``, ``--parity-chunks`` (:76-93) and the 14
 subcommands (:96-177): cat, config-info, cluster-info, cp, decode-shards,
 encode-shards, file-info, find-unused-hashes, get-hashes, http-gateway, ls,
-migrate, resilver, verify.
+migrate, resilver, verify — plus the TPU-repo extensions: scrub, stats,
+and meta-compact (cluster/meta_log.py maintenance + the
+``--from-path-store`` migration into the indexed metadata plane).
 
 Cluster locations are formatted ``cluster-name#path/to/file``; a location
 for the cluster definition may be used instead of a name
@@ -124,6 +126,20 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("ls", help="List the files in a cluster directory")
     p.add_argument("-r", "--recursive", action="store_true")
     p.add_argument("target")
+
+    p = sub.add_parser(
+        "meta-compact",
+        help="Compact a cluster's meta-log metadata store (reclaim "
+             "dead ref bytes, drop tombstones), optionally migrating "
+             "a file-per-ref tree into the log first")
+    p.add_argument("cluster")
+    p.add_argument(
+        "--from-path-store", metavar="DIR", default=None,
+        help="before compacting, import every ref file under DIR "
+             "(a 'type: path' metadata root) into the cluster's "
+             "meta-log store, byte-for-byte; names already live in "
+             "the log are skipped, so an interrupted migration simply "
+             "re-runs")
 
     p = sub.add_parser(
         "migrate",
@@ -325,6 +341,8 @@ async def _run_command(args, config) -> int:
         else:
             for entry in await target.list_files(config):
                 print(entry)
+    elif cmd == "meta-compact":
+        await meta_compact(config, args)
     elif cmd == "migrate":
         source = ClusterLocation.parse(args.source)
         destination = ClusterLocation.parse(args.destination)
@@ -376,6 +394,76 @@ async def _read_all(reader: aio.AsyncByteReader) -> bytes:
             break
         chunks.append(data)
     return b"".join(chunks)
+
+
+async def meta_compact(config, args) -> None:
+    """``meta-compact``: maintenance for the indexed metadata plane
+    (cluster/meta_log.py).  Compacts the cluster's meta-log store —
+    live refs copied into fresh log files, dead bytes reclaimed,
+    tombstones dropped, the journal swapped atomically — and, with
+    ``--from-path-store DIR``, first imports a file-per-ref metadata
+    tree into the log: every ref's bytes are appended EXACTLY as the
+    file holds them (byte identity across stores is the golden-pinned
+    contract), with the index projection extracted from the parsed
+    payload so the scrub/GC fast paths work for migrated refs too.
+    The import is idempotent — names already live in the log are
+    skipped — so an interrupted migration simply re-runs; unparseable
+    files are surfaced on stderr and skipped like every walk in this
+    CLI treats foreign entries."""
+    from chunky_bits_tpu.cluster.meta_log import (
+        MetadataLog,
+        extract_index_meta,
+        norm_name,
+    )
+    from chunky_bits_tpu.file.location import is_publish_temp
+
+    cluster = await config.get_cluster(args.cluster)
+    metadata = cluster.metadata
+    if not isinstance(metadata, MetadataLog):
+        raise ChunkyBitsError(
+            f"cluster {args.cluster!r} metadata is not a meta-log "
+            "store (set `metadata: {type: meta-log, ...}` in the "
+            "cluster config first)")
+    if args.from_path_store:
+        root = args.from_path_store
+        loads = metadata.format.loader()
+
+        def _walk() -> list[tuple[str, str]]:
+            out = []
+            for dirpath, _dirs, files in os.walk(root):
+                for fname in files:
+                    if is_publish_temp(fname):
+                        continue  # a crashed path-store writer's temp
+                    full = os.path.join(dirpath, fname)
+                    out.append(
+                        (norm_name(os.path.relpath(full, root)), full))
+            out.sort()
+            return out
+
+        def _import_one(name: str, full: str) -> bool:
+            if metadata.store.lookup(name) is not None:
+                return False  # already migrated: idempotent re-run
+            with open(full, "rb") as f:
+                data = f.read()
+            try:
+                payload = loads(data)
+            except Exception as err:  # noqa: BLE001 — a foreign file
+                # in the tree must not abort the migration
+                print(f"Skipping unparseable {full}: {err}",
+                      file=sys.stderr)
+                return False
+            hashes, nodes = extract_index_meta(payload)
+            metadata.store.append(name, data,
+                                  hashes=hashes, nodes=nodes)
+            return True
+
+        migrated = 0
+        for name, full in await asyncio.to_thread(_walk):
+            if await asyncio.to_thread(_import_one, name, full):
+                migrated += 1
+        print(f"Migrated {migrated} refs from {root}", file=sys.stderr)
+    report = await metadata.compact()
+    print(json.dumps(report))
 
 
 async def find_unused_hashes(config, args) -> None:

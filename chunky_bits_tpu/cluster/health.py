@@ -307,6 +307,20 @@ class HealthScoreboard:
             return (self._state_locked(node) != CLOSED
                     or node.err > self.DEGRADED_ERR)
 
+    def degraded_keys(self) -> frozenset:
+        """The ``location_key`` of every currently-degraded node, as
+        one set — the scrub priority pre-scan intersects a meta-log
+        index's per-ref node keys against this instead of calling
+        :meth:`degraded` once per replica of every ref in the
+        namespace.  Same predicate as :meth:`degraded`; the set is
+        small (nodes, not objects) and a point-in-time snapshot like
+        any single ``degraded`` call."""
+        with self._lock:
+            return frozenset(
+                key for key, node in self._nodes.items()
+                if (self._state_locked(node) != CLOSED
+                    or node.err > self.DEGRADED_ERR))
+
     def order(self, locations: Sequence) -> list:
         """``locations`` sorted best-health-first: closed breakers
         before half-open before open, lower error rate, lower EWMA

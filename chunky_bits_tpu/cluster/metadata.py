@@ -18,10 +18,11 @@ import json
 import os
 import time
 from dataclasses import dataclass
-from typing import Optional, Union
+from typing import TYPE_CHECKING, Optional, Union
 
 import yaml
 
+from chunky_bits_tpu.cluster import tunables
 from chunky_bits_tpu.errors import (
     LocationError,
     MetadataReadError,
@@ -61,6 +62,15 @@ class MetadataFormat:
         except (json.JSONDecodeError, yaml.YAMLError) as err:
             raise SerdeError(str(err)) from err
 
+    def loader(self):
+        """The raw bytes->obj parse callable with the format branch
+        hoisted — for batch consumers (the meta-log's
+        ``namespace_snapshot`` parses the whole namespace in one call,
+        where the per-call wrapper overhead of ``from_bytes`` is
+        measurable).  Raises the codec's native errors; batch callers
+        wrap them in SerdeError once per batch."""
+        return json.loads if self.name == JSON_STRICT else yaml_load
+
     async def from_location(self, location: Union[str, Location],
                             cx=None):
         if not isinstance(location, Location):
@@ -99,15 +109,31 @@ class FileOrDirectory:
         top = await FileOrDirectory.from_local_path(path)
         out = [top]
         if top.is_directory():
-            # the listdir itself must ride the thread hop: as an eager
-            # argument it would run on the loop (CB201)
-            names = sorted(await asyncio.to_thread(os.listdir, path))
-            for name in names:
-                child = os.path.join(path, name)
-                try:
-                    out.append(await FileOrDirectory.from_local_path(child))
-                except LocationError:
-                    continue
+
+            def _scan() -> list[tuple[str, str]]:
+                # one scandir pass: the dirent already carries the
+                # entry type, so N children cost one getdents stream
+                # instead of listdir + an isdir/isfile stat pair per
+                # name (entries that are neither — sockets, dangling
+                # links, raced unlinks — are skipped, same outcome as
+                # from_local_path's LocationError)
+                found = []
+                with os.scandir(path) as it:
+                    for entry in it:
+                        try:
+                            if entry.is_dir():
+                                found.append(("directory", entry.name))
+                            elif entry.is_file():
+                                found.append(("file", entry.name))
+                        except OSError:
+                            continue
+                found.sort(key=lambda t: t[1])
+                return found
+
+            # the scan must ride the thread hop: eager, it would run
+            # on the loop (CB201)
+            for kind, name in await asyncio.to_thread(_scan):
+                out.append(FileOrDirectory(kind, os.path.join(path, name)))
         return out
 
 
@@ -329,16 +355,32 @@ class MetadataGit:
                 "path": self.path}
 
 
-MetadataStore = Union[MetadataPath, MetadataGit]
+if TYPE_CHECKING:
+    from chunky_bits_tpu.cluster.meta_log import MetadataLog
+
+MetadataStore = Union[MetadataPath, MetadataGit, "MetadataLog"]
 
 
 def metadata_from_obj(obj: dict) -> MetadataStore:
-    """Tag-dispatched deserialization (metadata.rs:42-48)."""
-    if not isinstance(obj, dict) or "type" not in obj:
+    """Tag-dispatched deserialization (metadata.rs:42-48), extended
+    with the repo's ``meta-log`` kind (cluster/meta_log.py — ``kind:``
+    is accepted as an alias for the tag).  A fleet-wide
+    ``$CHUNKY_BITS_TPU_METADATA_KIND=meta-log``
+    (``tunables.metadata_kind``, read here = cluster-config load time)
+    rebuilds plain ``type: path`` stores as meta-logs over the same
+    root; stores with a ``put_script`` silently stay ``path`` (the log
+    has no per-write hook), mirroring ``$CHUNKY_BITS_TPU_CODE``'s
+    stay-rs-on-incompatible-profiles semantics."""
+    if not isinstance(obj, dict) or not ("type" in obj or "kind" in obj):
         raise SerdeError("metadata must be a mapping with a 'type' tag")
-    kind = obj["type"]
+    kind = obj["type"] if "type" in obj else obj["kind"]
     fmt = MetadataFormat(obj["format"]) if "format" in obj else None
     if kind == "path":
+        if (obj.get("put_script") is None
+                and tunables.metadata_kind() == "meta-log"):
+            from chunky_bits_tpu.cluster.meta_log import MetadataLog
+
+            return MetadataLog(path=obj["path"], format=fmt)
         return MetadataPath(
             path=obj["path"],
             format=fmt,
@@ -348,4 +390,10 @@ def metadata_from_obj(obj: dict) -> MetadataStore:
         )
     if kind == "git":
         return MetadataGit(path=obj["path"], format=fmt)
+    if kind == "meta-log":
+        # lazy import, like location.py's slab: the plain path store
+        # never pays for the log machinery
+        from chunky_bits_tpu.cluster.meta_log import MetadataLog
+
+        return MetadataLog(path=obj["path"], format=fmt)
     raise SerdeError(f"unknown metadata type {kind!r}")

@@ -26,7 +26,21 @@ measure-before-defaulting invariant.
 nodes (open/half-open breaker or high error EWMA, per the scoreboard)
 first: data co-resident with a failing disk is the data most likely to
 be the next loss, so it gets verified — and repaired — before the
-healthy tail of the namespace.
+healthy tail of the namespace.  On a meta-log store
+(cluster/meta_log.py) a second tier follows: files published since the
+previous pass (the bounded ``changes(since_generation)`` tail feed) —
+fresh writes are verified before the cold tail.
+
+**Metadata cost.**  On a meta-log store the priority pre-scan is a
+pure index scan (``_index_prescan``: publish-time node keys vs the
+scoreboard's degraded set — zero ref reads, zero parses, so ordering
+the whole namespace costs microseconds per thousand refs) and the
+verify walk fetches refs lazily, one ``FETCH_PAGE`` batch of grouped
+sequential log reads at a time — each ref's bytes read exactly once
+per pass, pass memory bounded by one page.  On the legacy store the
+pass reads each ref exactly once into a full snapshot that feeds both
+scoring and the walk (``_namespace_refs`` — the old shape read every
+ref twice per pass).
 
 **Concurrency shape** (the CB204 audience): the daemon is a plain
 asyncio task on its caller's loop; hashing hops to the host pipeline's
@@ -185,6 +199,10 @@ class ScrubDaemon:
     partitions are waited out, never answered with a republish storm).
     """
 
+    #: parsed refs fetched per batch on the index-pre-scan path — the
+    #: pass's peak object memory, and the grouped-read granularity
+    FETCH_PAGE = 256
+
     def __init__(self, cluster, bytes_per_sec: Optional[float] = None,
                  interval_seconds: float = 60.0, repair: bool = True,
                  profile_name: Optional[str] = None,
@@ -217,6 +235,10 @@ class ScrubDaemon:
         else:
             self._planner = None
         self._task: Optional[asyncio.Task] = None
+        #: high-water generation cursor for the meta-log ``changes()``
+        #: tail feed (0 = everything is new); only ever touched from
+        #: the pass loop, so unguarded
+        self._seen_generation = 0
         # counters are read by profiler reports and the gateway status
         # handler (possibly from another thread than the pass loop's)
         self._lock = threading.Lock()
@@ -284,9 +306,113 @@ class ScrubDaemon:
                     out.append(entry.path)
         return out
 
+    async def _namespace_refs(self) -> list[tuple[str, object]]:
+        """(path, parsed metadata obj) for every file in the namespace,
+        each ref's bytes read exactly ONCE for the whole pass.  A
+        meta-log store serves this from one index scan plus grouped
+        sequential log reads (``namespace_snapshot``); the legacy
+        file-per-ref store falls back to the recursive walk with one
+        read per path.  Either way the priority pre-scan and the verify
+        walk below share this single read — the old shape read every
+        ref TWICE per pass (once to score, once to scrub), which at
+        namespace scale doubled the pass's metadata cost on both
+        stores."""
+        metadata = self.cluster.metadata
+        snapshot = getattr(metadata, "namespace_snapshot", None)
+        if snapshot is not None:
+            try:
+                return list(await snapshot())
+            except ChunkyBitsError:
+                # a single foreign/corrupt ref poisons the batched
+                # read; the per-path walk below skips just that entry
+                # (a scrub must survive a half-broken namespace)
+                pass
+        out: list[tuple[str, object]] = []
+        for path in await self._list_file_paths():
+            try:
+                out.append((path, await metadata.read(path)))
+            except ChunkyBitsError:
+                continue  # unparseable/foreign metadata: skip
+        return out
+
+    async def _recent_paths(self) -> frozenset:
+        """Paths published since the previous pass, from the meta-log
+        ``changes(since_generation)`` tail feed — empty on stores
+        without one (and after a compaction dropped the cursor's
+        window, which simply reads as nothing-recent).  One bounded
+        page per pass: a hint tier, not an audit log."""
+        changes = getattr(self.cluster.metadata, "changes", None)
+        if changes is None:
+            return frozenset()
+        try:
+            rows = await changes(self._seen_generation)
+        except ChunkyBitsError:
+            return frozenset()
+        if rows:
+            self._seen_generation = max(r.generation for r in rows)
+        return frozenset(r.name for r in rows if not r.tombstone)
+
+    async def _index_prescan(self) -> Optional[list[tuple[int, str]]]:
+        """Priority-scored (prio, path) for the whole namespace from
+        ONE meta-log index scan — zero ref reads, zero parses: each
+        ref's publish-time node keys (``namespace_nodes``) are
+        intersected with the scoreboard's degraded-key set, and the
+        ``changes()`` feed promotes fresh writes, exactly like the
+        snapshot path scores below.  None on stores without the
+        projection (legacy store, or any ref published without one) —
+        the caller falls back to the full snapshot read, so scoring is
+        never silently partial."""
+        index = getattr(self.cluster.metadata, "namespace_nodes", None)
+        if index is None:
+            return None
+        try:
+            rows = await index()
+        except ChunkyBitsError:
+            return None
+        if rows is None:
+            return None
+        recent = await self._recent_paths()
+        degraded = self.cluster.health_scoreboard().degraded_keys()
+        out: list[tuple[int, str]] = []
+        for path, nodes in rows:
+            prio = 2
+            if degraded and any(key in degraded for key in nodes):
+                prio = 0
+            elif path in recent:
+                prio = 1
+            out.append((prio, path))
+        return out
+
+    async def _fetch_objs(self, paths: list) -> dict:
+        """path -> parsed metadata obj for one page of the verify walk
+        (index-pre-scan path only).  Batched through the meta-log's
+        ``read_objs`` (grouped sequential log reads); a poisoned batch
+        or a store without one degrades to per-path reads, and per-path
+        failures skip just that entry — a scrub must survive a
+        half-broken namespace."""
+        if not paths:
+            return {}
+        metadata = self.cluster.metadata
+        reader = getattr(metadata, "read_objs", None)
+        if reader is not None:
+            try:
+                return dict(await reader(paths))
+            except ChunkyBitsError:
+                pass  # isolate the bad entry via the per-path loop
+        out: dict = {}
+        for path in paths:
+            try:
+                out[path] = await metadata.read(path)
+            except ChunkyBitsError:
+                continue
+        return out
+
     def _ref_priority(self, ref) -> int:
         """0 = any chunk replica lives on a degraded node (scan first),
-        1 = all-healthy.  With no health data every ref scores 1 and
+        2 = all-healthy (``run_once`` promotes recently-written
+        all-healthy refs to tier 1 via the ``changes()`` feed — fresh
+        writes get verified before the cold tail of the namespace).
+        With no health data and no recency feed every ref scores 2 and
         the pass order is the plain namespace order."""
         health = self.cluster.health_scoreboard()
         for part in ref.parts:
@@ -294,7 +420,7 @@ class ScrubDaemon:
                 for location in chunk.locations:
                     if health.degraded(location):
                         return 0
-        return 1
+        return 2
 
     async def _verify_chunk(self, chunk, location, cx, pipe
                             ) -> tuple[Optional[bool], Optional[bytes]]:
@@ -489,15 +615,29 @@ class ScrubDaemon:
             self._bump(repair_failures=1)
 
     async def run_once(self) -> ScrubStats:
-        """One full pass over the namespace, degraded-resident files
-        first.  Returns the cumulative stats snapshot.
+        """One full pass over the namespace: degraded-resident files
+        first, recently-written files next, the healthy cold tail last.
+        Returns the cumulative stats snapshot.
 
-        Only the path list (plus an int priority each) is held across
-        the pass — refs are fetched per file, right before their scrub,
-        never retained: a rate-bounded pass can run for hours, and at
-        namespace scale holding every parsed FileReference would be
-        unbounded memory AND guarantee every repair republishes
-        hours-stale metadata."""
+        On a meta-log store the priority pre-scan is a pure INDEX scan
+        (``_index_prescan``: per-ref node keys intersected with the
+        scoreboard's degraded set — zero ref reads, zero parses), and
+        the verify walk fetches parsed refs lazily in priority order,
+        one page at a time (``_fetch_objs`` -> ``read_objs``: grouped
+        sequential log reads), so pass memory peaks at the index plus
+        ONE page of objects and degraded-tier scrubbing starts
+        immediately instead of after a full-namespace read.  On the
+        legacy store the pass falls back to one full snapshot
+        (``_namespace_refs`` — each ref's bytes still read exactly
+        once; the old shape read every ref twice).  Holding scored
+        paths across a (rate-bounded, possibly hours-long) pass is
+        safe from clobbering client writes because the repair
+        republish is FENCED on a fresh metadata read still matching
+        the obj as fetched (``_scrub_ref``) — a raced overwrite wins,
+        and chunk rewrites are content-addressed in-place either way.
+        NOTE: scoring and fetching bypass ``get_file_ref`` — a pass
+        must not churn the serving path's file-ref LRU (it would evict
+        every hot ref the gateway is using)."""
         started = _clock.monotonic()
         cx = self.cluster.tunables.location_context()
         if self.profiler is not None:
@@ -506,28 +646,43 @@ class ScrubDaemon:
             # no-profiler fast paths, identically for every leg
             cx = cx.but_with(profiler=self.profiler)
         pipe = self.cluster.host_pipeline()
-        paths = await self._list_file_paths()
-        scored: list[tuple[int, str]] = []
-        for path in paths:
-            try:
-                # metadata.read, NOT get_file_ref: the priority
-                # pre-scan sweeps the whole namespace and must not
-                # churn the serving path's file-ref LRU (a pass would
-                # evict every hot ref the gateway is using)
-                ref = _ref_from_obj(
-                    await self.cluster.metadata.read(path))
-            except ChunkyBitsError:
-                continue  # unparseable/foreign metadata: skip
-            scored.append((self._ref_priority(ref), path))
+        scored: list[tuple[int, str, object]] = []
+        plan = await self._index_prescan()
+        if plan is not None:
+            scored = [(prio, path, None) for prio, path in plan]
+        else:
+            refs = await self._namespace_refs()
+            recent = await self._recent_paths()
+            for path, obj in refs:
+                try:
+                    ref = _ref_from_obj(obj)
+                except ChunkyBitsError:
+                    continue  # unparseable/foreign metadata: skip
+                prio = self._ref_priority(ref)
+                if prio != 0 and path in recent:
+                    prio = 1
+                scored.append((prio, path, obj))
+            del refs, recent
+        # stable by priority only: within a tier the index's own order
+        # (namespace order) is preserved, like the old pass
         scored.sort(key=lambda t: t[0])
-        for _prio, path in scored:
-            try:
-                obj = await self.cluster.metadata.read(path)
-                snapshot = _canonical(obj)
-                ref = _ref_from_obj(obj)
-            except ChunkyBitsError:
-                continue  # deleted/rewritten mid-pass: next pass's job
-            await self._scrub_ref(path, ref, cx, pipe, snapshot)
+        scored.reverse()  # pop() below consumes from the front
+        while scored:
+            page = [scored.pop()
+                    for _ in range(min(self.FETCH_PAGE, len(scored)))]
+            fetched = await self._fetch_objs(
+                [path for _prio, path, obj in page if obj is None])
+            for _prio, path, obj in page:
+                if obj is None:
+                    obj = fetched.get(path)
+                    if obj is None:
+                        continue  # deleted/raced since the pre-scan
+                try:
+                    snapshot = _canonical(obj)
+                    ref = _ref_from_obj(obj)
+                except ChunkyBitsError:
+                    continue
+                await self._scrub_ref(path, ref, cx, pipe, snapshot)
         with self._lock:
             self._passes += 1
             self._last_pass_seconds = _clock.monotonic() - started

@@ -154,6 +154,17 @@ XOR_SCHEDULE_ENV = "CHUNKY_BITS_TPU_XOR_SCHEDULE"
 #: read-at-first-dispatch contract, set it before the first write.
 CODE_ENV = "CHUNKY_BITS_TPU_CODE"
 
+#: fleet-wide metadata-store kind override (cluster/metadata.py
+#: ``metadata_from_obj``): ``meta-log`` rebuilds every ``type: path``
+#: store (without a ``put_script`` — the log has no per-write hook) as
+#: the indexed meta-log over the same root (cluster/meta_log.py).
+#: Per-cluster YAML ``metadata: {type: meta-log}`` is the explicit
+#: opt-in; this env var flips the default fleet-wide, like
+#: ``CHUNKY_BITS_TPU_CODE`` does for erasure codes — and like it,
+#: silently stays on the configured kind when incompatible.  Read when
+#: a cluster config is loaded — set it before the cluster is built.
+METADATA_KIND_ENV = "CHUNKY_BITS_TPU_METADATA_KIND"
+
 #: SLO engine evaluation cadence in seconds (obs/slo.py +
 #: gateway/http.py): > 0 runs the windowed burn-rate alert engine —
 #: a bounded ring of registry snapshots evaluated against the closed
@@ -288,6 +299,18 @@ def erasure_code(*, default: str = "rs") -> str:
 
     raw = os.environ.get(CODE_ENV, "").strip()
     return raw if raw in KNOWN_CODES else default
+
+
+def metadata_kind(*, default: str = "") -> str:
+    """Requested fleet-wide metadata-store kind from
+    ``$CHUNKY_BITS_TPU_METADATA_KIND`` for clusters whose YAML says
+    ``type: path``.  Lenient like ``erasure_code`` — only the shipped
+    override value ``meta-log`` is honored, anything else reads as
+    ``default`` ("" = no override, file-per-ref stays the default);
+    compatibility is the caller's check (``metadata_from_obj`` keeps
+    ``path`` when a ``put_script`` is configured)."""
+    raw = os.environ.get(METADATA_KIND_ENV, "").strip()
+    return raw if raw == "meta-log" else default
 
 
 def gateway_workers(*, default: int = 1) -> int:

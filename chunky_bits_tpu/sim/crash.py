@@ -754,6 +754,181 @@ def _verify_metadata(image: str, state: dict, mode: str, k: int,
     return violations
 
 
+# -- meta-log publication / compaction (cluster/meta_log.py) --
+
+def _fresh_meta_store(root: str):
+    """A COLD MetaLogStore over ``root`` — deliberately not
+    ``meta_log.get_store`` (same rationale as ``_fresh_store``: the
+    process cache would hand back a warm index and defeat the
+    restart-from-disk contract under test)."""
+    from chunky_bits_tpu.cluster.meta_log import MetaLogStore
+
+    return MetaLogStore(root)
+
+
+def _verify_meta_log_image(image: str, expected: dict[str, bytes],
+                           pending: Optional[tuple[str, bytes]],
+                           mode: str, complete: bool) -> list[str]:
+    """The shared meta-log oracle — STRONGER than the slab oracle on
+    the pending entry: a meta-log publish is the cluster's write
+    acknowledgment (ref bytes fsync'd, then the journal line fsync'd,
+    then a directory fsync when a file was created), so a COMPLETED
+    append must survive EVERY failure model, both power-cut extremes
+    included — and a ref the index serves at all must serve exact
+    bytes in every mode (the journal line only ever lands after its
+    ref bytes are on the platter, and torn journal lines are never
+    applied)."""
+    violations: list[str] = []
+    try:
+        store = _fresh_meta_store(image)
+        names = set(store.live_names())
+    except Exception as err:  # noqa: BLE001 — ANY cold-load crash is
+        # itself the invariant violation being hunted
+        return [f"cold index load failed: {type(err).__name__}: {err}"]
+
+    def read(name: str) -> bytes:
+        try:
+            return store.read_bytes(name)
+        except OSError:
+            return b""
+
+    for name, payload in expected.items():
+        if name not in names:
+            violations.append(f"durable ref {name!r} lost (mode={mode})")
+        elif read(name) != payload:
+            violations.append(f"durable ref {name!r} wrong bytes")
+    if pending is not None:
+        name, payload = pending
+        if name in names and read(name) != payload:
+            violations.append(
+                f"indexed ref {name!r} serves wrong bytes (mode={mode}:"
+                " journal committed before its data was durable)")
+        if complete and name not in names:
+            violations.append(
+                f"acked metadata publish lost (mode={mode})")
+    extras = names - set(expected) \
+        - ({pending[0]} if pending else set())
+    if extras:
+        violations.append(f"phantom refs {sorted(extras)[:2]}")
+
+    # forward progress: the next publish must terminate any torn
+    # journal tail and serve its bytes back
+    recovery_payload = b"recovery-" + os.urandom(8)
+    try:
+        store.append("recovery-obj", recovery_payload)
+    except Exception as err:  # noqa: BLE001 — ANY recovery-append
+        # failure on a crash image is the finding
+        violations.append(f"recovery publish failed: "
+                          f"{type(err).__name__}: {err}")
+        return violations
+    reloaded = _fresh_meta_store(image)
+    try:
+        if reloaded.read_bytes("recovery-obj") != recovery_payload:
+            violations.append("recovery publish unreadable after "
+                              "reload")
+    except OSError:
+        violations.append("recovery publish invisible after reload")
+    for name, payload in expected.items():
+        if name in names:
+            try:
+                if reloaded.read_bytes(name) != payload:
+                    violations.append("recovery publish disturbed a "
+                                      "durable ref")
+                    break
+            except OSError:
+                violations.append("recovery publish lost a durable ref")
+                break
+    return violations
+
+
+def _proj_of(name: str) -> tuple[list, list]:
+    """Deterministic index projection (hashes, node keys) for a setup
+    ref — publish records in the matrix carry the projection fields so
+    every crash point of the LONGER journal line (and compaction's
+    projection copy) is replayed too."""
+    digest = "sha256-" + name.encode().hex().ljust(64, "0")[:64]
+    return [digest], [["local", f"/nodes/{name.split('/')[-1]}"]]
+
+
+def _setup_meta_log(root: str, rng: random.Random) -> dict:
+    store = _fresh_meta_store(root)
+    expected: dict[str, bytes] = {}
+    for i in range(3):
+        payload = rng.randbytes(rng.randrange(100, 900))
+        name = f"dir/obj-{i}"
+        hashes, nodes = _proj_of(name)
+        store.append(name, payload, hashes=hashes, nodes=nodes)
+        expected[name] = payload
+    # a tombstone gives compaction real work (dead bytes + a dropped
+    # record) and pins that replays keep it dead
+    doomed = rng.randbytes(300)
+    store.append("dir/doomed", doomed)
+    store.tombstone("dir/doomed")
+    new_payload = rng.randbytes(700)
+    return {"expected": expected, "gen": store.generation(),
+            "new": ("dir/obj-new", new_payload)}
+
+
+def _run_meta_log_append(root: str, state: dict) -> None:
+    name, payload = state["new"]
+    hashes, nodes = _proj_of(name)
+    _fresh_meta_store(root).append(name, payload,
+                                   hashes=hashes, nodes=nodes)
+
+
+def _verify_meta_log_append(image: str, state: dict, mode: str, k: int,
+                            complete: bool) -> list[str]:
+    return _verify_meta_log_image(image, state["expected"],
+                                  state["new"], mode, complete)
+
+
+def _run_meta_log_compact(root: str, state: dict) -> None:
+    _fresh_meta_store(root).compact()
+
+
+def _verify_meta_log_compact(image: str, state: dict, mode: str, k: int,
+                             complete: bool) -> list[str]:
+    violations = _verify_meta_log_image(image, state["expected"], None,
+                                        mode, complete)
+    # old journal or new journal, never neither: the shared oracle
+    # already proved every durable ref readable; pin that the journal
+    # FILE survived every image (a missing journal is an empty store)
+    from chunky_bits_tpu.cluster import meta_log as _ml
+
+    if not os.path.isfile(os.path.join(image, _ml.JOURNAL_NAME)):
+        violations.append("compaction crash left no journal at all")
+    if complete:
+        store = _fresh_meta_store(image)
+        # a completed compaction is an acknowledged swap (tmp fsync +
+        # rename + dir fsync): the reclaim must survive both power-cut
+        # extremes...
+        if store.dead_bytes() != 0:
+            violations.append("completed compaction rolled back "
+                              f"(mode={mode}: dead bytes resurfaced)")
+        # ...and so must the generation floor record — a counter that
+        # ran backwards would hand re-used generations to changes()
+        # cursors
+        if store.generation() < state["gen"]:
+            violations.append(
+                f"generation ran backwards across compaction "
+                f"({store.generation()} < {state['gen']}, mode={mode})")
+        # ...and so must the index projections (scrub pre-scan / GC
+        # fast paths): a compaction that dropped them would silently
+        # demote every consumer to the fallback read forever
+        for name in state["expected"]:
+            entry = store.lookup(name)
+            hashes, nodes = _proj_of(name)
+            if entry is not None and (
+                    entry.hashes != tuple(hashes)
+                    or entry.nodes != tuple(
+                        tuple(p) for p in nodes)):
+                violations.append(
+                    f"index projection lost across compaction "
+                    f"({name!r}, mode={mode})")
+                break
+    return violations
+
+
 MUTATIONS: dict[str, Mutation] = {
     m.name: m for m in (
         Mutation("slab_append", _setup_slab, _run_slab_append,
@@ -768,6 +943,10 @@ MUTATIONS: dict[str, Mutation] = {
                  _verify_publish),
         Mutation("metadata_publish", _setup_metadata, _run_metadata,
                  _verify_metadata),
+        Mutation("meta_log_append", _setup_meta_log,
+                 _run_meta_log_append, _verify_meta_log_append),
+        Mutation("meta_log_compact", _setup_meta_log,
+                 _run_meta_log_compact, _verify_meta_log_compact),
     )
 }
 

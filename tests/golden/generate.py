@@ -142,6 +142,31 @@ async def build_refs() -> dict[str, dict]:
                  .with_code("pm-msr")
                  .write(aio.BytesReader(payload(100_000, 1))))
     refs["pm_msr_placement"] = ref.to_obj()
+
+    # 7. fixture 3's exact payload/weights with the metadata published
+    # through the indexed meta-log store (cluster/meta_log.py) and read
+    # back from the log before freezing: pins that the append-only
+    # store round-trips refs byte-identically to file-per-ref — the
+    # store changes where METADATA lives, never its bytes (the mirror
+    # test asserts this fixture equals fixture 3 exactly)
+    with tempfile.TemporaryDirectory() as tmp:
+        cwd = os.getcwd()
+        os.chdir(tmp)
+        try:
+            for i in range(5):
+                os.mkdir(f"d{i}")
+            spec = cluster_spec("meta")
+            spec["metadata"] = {"type": "meta-log", "format": "yaml",
+                                "path": "meta"}
+            cluster = Cluster.from_obj(spec)
+            profile = cluster.get_profile()
+            ref = await (cluster.get_file_writer(profile)
+                         .write(aio.BytesReader(payload(30_000, 3))))
+            await cluster.metadata.write("golden/ref", ref.to_obj())
+            refs["meta_log_placement"] = await cluster.metadata.read(
+                "golden/ref")
+        finally:
+            os.chdir(cwd)
     return refs
 
 

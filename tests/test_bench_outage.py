@@ -499,6 +499,58 @@ def test_config17_failure_emits_one_json_line():
     assert "error" in rec
 
 
+def test_config18_smoke_emits_one_json_line():
+    """--config 18 --smoke (indexed metadata plane A/B at CI scale:
+    10^3 objects, file-per-ref vs meta-log) honors the driver
+    contract: exactly one parseable JSON line on stdout with the
+    required keys, exit 0 — and the run itself asserts sampled refs
+    byte-identical between the stores, the GC liveness sets
+    set-equal, and the scrub pre-scan / GC walk answered from the
+    index projections with zero ref reads."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "18", "--smoke"],
+        cwd=REPO, env=env, capture_output=True, timeout=300)
+    assert r.returncode == 0, r.stderr.decode()[-800:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "objects",
+                "put_path_ops", "put_log_ops", "list_path_ms",
+                "list_log_ms", "list_speedup", "prefix_speedup",
+                "scrub_meta_speedup", "gc_live_speedup",
+                "snapshot_log_ms", "cold_index_ms",
+                "refs_byte_identical"):
+        assert key in rec
+    assert rec["unit"] == "x"
+    assert rec["value"] > 0
+    assert rec["objects"] == 1000
+    assert rec["refs_byte_identical"] > 0
+    # smoke scale pins correctness (identity + index answers), not
+    # the >= 10x acceptance ratios — those are BASELINE.md's 10^4 rows
+    assert rec["scrub_meta_speedup"] > 0
+    assert rec["gc_live_speedup"] > 0
+
+
+def test_config18_failure_emits_one_json_line():
+    """ANY --config 18 failure (here: a non-positive object count)
+    still produces exactly one parseable JSON line and exit 3 — the
+    same contract as configs 8-17 and the device runs."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "18",
+         "--objects", "0"],
+        cwd=REPO, env=env, capture_output=True, timeout=120)
+    assert r.returncode == 3, r.stderr.decode()[-500:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec
+    assert rec["value"] == 0.0
+    assert "error" in rec
+
+
 def test_seams_only_shrink_and_tolerate_garbage():
     """Inherited env values must not break the contract: malformed or
     larger-than-default values fall back to the real budget."""

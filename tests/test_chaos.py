@@ -60,9 +60,9 @@ def test_chaos_soak(tmp_path, seed):
         return t[len("file://"):] if t.startswith("file://") else t
 
     async def read_meta(name):
-        import yaml
-
-        return yaml.safe_load((meta / name).read_text())
+        # through the store surface, not the raw path layout — the
+        # meta-log CI leg rebuilds plain path stores fleet-wide
+        return await cluster.metadata.read(name)
 
     async def op_write(name):
         size = int(rng.integers(1, 60000))

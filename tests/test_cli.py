@@ -37,6 +37,15 @@ def run_cli(*argv, check=True, pipe_to=None, **kwargs):
     return result
 
 
+def ref_obj(cluster_yaml, name):
+    """Parsed file reference through the metadata surface (file-info),
+    independent of the store's on-disk layout — a plain ``type: path``
+    store may be running as a meta-log under
+    ``$CHUNKY_BITS_TPU_METADATA_KIND`` (the CI meta-log leg)."""
+    return yaml.safe_load(
+        run_cli("file-info", f"{cluster_yaml}#{name}").stdout)
+
+
 @pytest.fixture
 def cluster_yaml(tmp_path):
     dirs = []
@@ -85,11 +94,14 @@ def test_cp_cat_roundtrip(cluster_yaml, tmp_path):
     out = run_cli("cat", f"{cluster_yaml}#files/input.bin")
     assert hashlib.sha256(out.stdout).hexdigest() == \
         hashlib.sha256(payload).hexdigest()
-    # read through the file-reference scheme too (cp @#ref out)
-    meta = yaml.safe_load(
-        (tmp_path / "metadata" / "files" / "input.bin").read_text())
+    # read through the file-reference scheme too (cp @#ref out) — the
+    # ref is exported to a standalone file so the @# grammar is
+    # exercised regardless of the metadata store's on-disk layout
+    meta = ref_obj(cluster_yaml, "files/input.bin")
     assert meta["length"] == len(payload)
-    out = run_cli("cat", f"@#{tmp_path}/metadata/files/input.bin")
+    ref_file = tmp_path / "input.ref"
+    ref_file.write_text(yaml.safe_dump(meta))
+    out = run_cli("cat", f"@#{ref_file}")
     assert out.stdout == payload
 
 
@@ -114,8 +126,7 @@ def test_ls(cluster_yaml, tmp_path):
 def test_verify_and_resilver_cli(cluster_yaml, tmp_path):
     payload = os.urandom(200000)
     run_cli("cp", "-", f"{cluster_yaml}#victim", input=payload)
-    meta = yaml.safe_load(
-        (tmp_path / "metadata" / "victim").read_text())
+    meta = ref_obj(cluster_yaml, "victim")
     # delete one chunk file
     victim_loc = meta["parts"][0]["data"][0]["locations"][0]
     os.remove(victim_loc)
@@ -172,8 +183,7 @@ def test_migrate(cluster_yaml, tmp_path):
     out = run_cli("cat", f"{cluster_yaml}#migrated")
     assert out.stdout == payload
     # the data was NOT copied: chunk locations are range views of src
-    meta = yaml.safe_load(
-        (tmp_path / "metadata" / "migrated").read_text())
+    meta = ref_obj(cluster_yaml, "migrated")
     first_loc = meta["parts"][0]["data"][0]["locations"][-1]
     assert str(src) in first_loc and first_loc.startswith("(")
     # a migrated ref is Degraded until resilver materializes the parity
@@ -259,7 +269,11 @@ def test_python_decoder_interop(cluster_yaml, tmp_path):
     src = tmp_path / "in.bin"
     src.write_bytes(payload)
     run_cli("cp", str(src), f"{cluster_yaml}#files/interop")
-    ref_path = tmp_path / "metadata" / "files" / "interop"
+    # export the ref to a standalone file: the decoder's contract is
+    # "a file-reference file", not any particular metadata store layout
+    ref_path = tmp_path / "interop.ref"
+    ref_path.write_text(yaml.safe_dump(ref_obj(cluster_yaml,
+                                               "files/interop")))
     env = dict(os.environ)
     env.setdefault("PYTHONPATH", REPO)
     proc = subprocess.run(
@@ -293,6 +307,6 @@ def test_cp_cluster_to_cluster(cluster_yaml, tmp_path):
     out = run_cli("cat", f"{second}#dst-obj")
     assert out.stdout == payload
     # second cluster re-encoded with its own geometry
-    meta = yaml.safe_load((meta2 / "dst-obj").read_text())
+    meta = ref_obj(second, "dst-obj")
     assert len(meta["parts"][0]["data"]) == 4
     assert len(meta["parts"][0]["parity"]) == 1

@@ -26,12 +26,21 @@ def test_fixtures_match_current_behavior():
     refs = asyncio.run(gen.build_refs())
     assert set(refs) == {"void_small", "void_wide", "cluster_placement",
                          "slab_placement", "block_digests",
-                         "pm_msr_placement"}
+                         "pm_msr_placement", "meta_log_placement"}
     for name, obj in refs.items():
         assert gen.dump(obj) == golden_text(name), (
             f"golden fixture {name} drifted — wire compatibility broken "
             "(or an intentional change: regenerate via "
             "tests/golden/generate.py and document it)")
+
+
+def test_meta_log_fixture_identical_to_path_store():
+    """Fixture 7 must equal fixture 3 byte-for-byte: the meta-log store
+    is a metadata LAYOUT (append-only log + index), never a wire-format
+    change — a ref published to the log and read back serializes
+    exactly like one published file-per-ref."""
+    assert golden_text("meta_log_placement") \
+        == golden_text("cluster_placement")
 
 
 def test_slab_fixture_mirrors_path_placement():

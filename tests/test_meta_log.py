@@ -1,0 +1,436 @@
+"""Indexed metadata plane (cluster/meta_log.py): the append-only
+namespace log + compacting index behind the ``MetadataStore`` surface.
+
+Covers the store protocol (append/read/list/tombstone/compact with the
+journal-committed index), the flock'd cross-instance and cross-process
+append discipline, torn-tail recovery, generation fencing against the
+cluster's file-ref LRU, the index projection fast paths
+(``namespace_nodes``/``namespace_hashes`` + their fall-back-on-absence
+contract), and the O(index) no-dirent listing claim — asserted by
+counting dirent syscalls, not by timing.  Crash-mode durability is the
+crash harness's job (sim/crash.py ``meta_log_append``/
+``meta_log_compact``, tests/test_crash.py); byte identity across stores
+is the golden ``meta_log_placement`` fixture's job.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from chunky_bits_tpu.cluster import meta_log
+from chunky_bits_tpu.cluster.meta_log import (
+    MetadataLog,
+    MetaLogStore,
+    norm_name,
+)
+from chunky_bits_tpu.errors import MetadataReadError
+
+
+def store_at(tmp_path, name="m", **kwargs) -> MetaLogStore:
+    return MetaLogStore(str(tmp_path / name), **kwargs)
+
+
+# ---- name canonicalization ----
+
+def test_norm_name_strips_traversal_and_empty_components():
+    assert norm_name("a/b/c") == "a/b/c"
+    assert norm_name("/a//b/./../c/") == "a/b/c"
+    assert norm_name(".") == ""
+    assert norm_name("") == ""
+
+
+# ---- store protocol ----
+
+def test_append_read_list_roundtrip(tmp_path):
+    store = store_at(tmp_path)
+    store.append("dir/a", b"ref-a")
+    store.append("dir/sub/b", b"ref-b")
+    store.append("top", b"ref-top")
+
+    assert store.read_bytes("dir/a") == b"ref-a"
+    assert store.live_names() == ["dir/a", "dir/sub/b", "top"]
+    assert store.prefix_names("dir") == ["dir/a", "dir/sub/b"]
+    assert store.prefix_names("") == ["dir/a", "dir/sub/b", "top"]
+
+    kind, children = store.list_children("")
+    assert kind == "directory"
+    assert children == [("directory", "dir"), ("file", "top")]
+    kind, children = store.list_children("dir")
+    assert children == [("file", "a"), ("directory", "sub")]
+    assert store.list_children("top") == ("file", [])
+    assert store.list_children("nope") is None
+
+
+def test_missing_and_tombstoned_names_raise_enoent(tmp_path):
+    store = store_at(tmp_path)
+    with pytest.raises(FileNotFoundError):
+        store.read_bytes("ghost")
+    store.append("x", b"one")
+    store.tombstone("x")
+    with pytest.raises(FileNotFoundError):
+        store.read_bytes("x")
+    with pytest.raises(FileNotFoundError):
+        store.tombstone("x")  # double delete = ENOENT, like os.remove
+    # a republish after the tombstone is a fresh live entry
+    store.append("x", b"two")
+    assert store.read_bytes("x") == b"two"
+
+
+def test_supersede_and_tombstone_mark_dead_bytes(tmp_path):
+    store = store_at(tmp_path)
+    store.append("a", b"x" * 100)
+    assert store.dead_bytes() == 0
+    store.append("a", b"y" * 40)
+    assert store.dead_bytes() == 100
+    store.tombstone("a")
+    assert store.dead_bytes() == 140
+
+
+def test_cold_reload_rebuilds_identical_index(tmp_path):
+    store = store_at(tmp_path)
+    store.append("a", b"ref-a")
+    store.append("b/c", b"ref-c")
+    store.tombstone("a")
+    cold = MetaLogStore(store.root)
+    assert cold.live_names() == ["b/c"]
+    assert cold.read_bytes("b/c") == b"ref-c"
+    assert cold.generation() == store.generation()
+
+
+def test_rollover_past_log_max_bytes(tmp_path):
+    store = store_at(tmp_path, log_max_bytes=64)
+    for i in range(6):
+        store.append(f"n{i}", bytes([65 + i]) * 40)
+    assert len(store.log_files()) >= 3
+    for i in range(6):
+        assert store.read_bytes(f"n{i}") == bytes([65 + i]) * 40
+    # read_many groups by log file and returns input order
+    entries = store.entries_for([f"n{i}" for i in (4, 1, 3)])
+    raw = store.read_many(entries)
+    assert [name for name, _ in raw] == ["n4", "n1", "n3"]
+    assert raw[0][1] == b"E" * 40
+
+
+def test_compact_reclaims_drops_tombstones_and_keeps_generation(tmp_path):
+    store = store_at(tmp_path)
+    store.append("keep", b"k" * 50)
+    store.append("dead", b"d" * 70)
+    store.append("keep", b"K" * 30)  # supersede: 50 bytes dead
+    store.tombstone("dead")
+    gen = store.generation()
+    old_logs = [store.log_path(log) for log in store.log_files()]
+
+    report = store.compact()
+    assert report == {"copied_bytes": 30, "reclaimed_bytes": 120,
+                      "live_refs": 1}
+    assert store.read_bytes("keep") == b"K" * 30
+    assert store.dead_bytes() == 0
+    # the {"o": "g"} floor record: the counter never runs backwards
+    # across the journal swap, so a changes() cursor stays valid
+    assert store.generation() == gen
+    assert store.changes(gen) == []
+    store.append("later", b"l")
+    assert store.generation() == gen + 1
+    # the dropped tombstone is gone from a cold reload too
+    assert MetaLogStore(store.root).live_names() == ["keep", "later"]
+    assert all(not os.path.exists(p) for p in old_logs)
+
+
+def test_changes_feed_is_bounded_and_generation_ordered(tmp_path):
+    store = store_at(tmp_path)
+    for i in range(5):
+        store.append(f"n{i}", b"x")
+    store.tombstone("n2")
+    rows = store.changes(0)
+    assert [r.generation for r in rows] == sorted(
+        r.generation for r in rows)
+    # the index is compacting: n2 shows only its LATEST state
+    assert [(r.name, r.tombstone) for r in rows if r.name == "n2"] \
+        == [("n2", True)]
+    assert len(store.changes(0, limit=2)) == 2
+    cursor = rows[-1].generation
+    assert store.changes(cursor) == []
+    store.append("n9", b"y")
+    assert [r.name for r in store.changes(cursor)] == ["n9"]
+
+
+# ---- torn tails and concurrent appenders ----
+
+def test_torn_journal_tail_ignored_and_terminated(tmp_path):
+    store = store_at(tmp_path)
+    store.append("a", b"ref-a")
+    store.append("b", b"ref-b")
+    with open(store.journal_path(), "ab") as f:
+        f.write(b'{"o":"p","n":"torn","g":9')  # crashed writer, no \n
+
+    cold = MetaLogStore(store.root)
+    assert cold.live_names() == ["a", "b"]
+    # the next append terminates the fragment instead of merging into
+    # it, and every instance converges on the same three names
+    cold.append("c", b"ref-c")
+    assert cold.live_names() == ["a", "b", "c"]
+    assert MetaLogStore(store.root).live_names() == ["a", "b", "c"]
+    assert store.live_names() == ["a", "b", "c"]
+
+
+def test_foreign_garbage_journal_line_is_skipped(tmp_path):
+    store = store_at(tmp_path)
+    store.append("a", b"ref-a")
+    with open(store.journal_path(), "ab") as f:
+        f.write(b"not json at all\n")
+    store.append("b", b"ref-b")
+    assert MetaLogStore(store.root).live_names() == ["a", "b"]
+
+
+def test_concurrent_appends_from_two_instances(tmp_path):
+    """Two store instances over one root (the cross-process shape in
+    miniature): flock-serialized appends from concurrent threads all
+    publish, and both indexes converge."""
+    root = str(tmp_path / "m")
+    a, b = MetaLogStore(root), MetaLogStore(root)
+    errors = []
+
+    def writer(store, prefix):
+        try:
+            for i in range(20):
+                store.append(f"{prefix}/{i:02d}",
+                             f"{prefix}{i}".encode() * 10)
+        except Exception as err:  # noqa: BLE001 — surfaced via errors
+            errors.append(err)
+
+    threads = [threading.Thread(target=writer, args=(a, "a"), daemon=True),
+               threading.Thread(target=writer, args=(b, "b"), daemon=True)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors, errors
+    assert len(a.live_names()) == 40
+    assert len(b.live_names()) == 40
+    assert a.read_bytes("b/07") == b"b7" * 10
+    # generations are unique: no two publishes ever shared one
+    gens = [r.generation for r in a.changes(0, limit=100)]
+    assert len(gens) == len(set(gens)) == 40
+
+
+def test_cross_process_append_is_flock_serialized(tmp_path):
+    """A real second PROCESS appends through its own store instance;
+    the parent observes the publishes on its next (refreshing) read."""
+    root = str(tmp_path / "m")
+    parent = MetaLogStore(root)
+    parent.append("parent/0", b"from-parent")
+    script = (
+        "from chunky_bits_tpu.cluster.meta_log import MetaLogStore\n"
+        f"store = MetaLogStore({root!r})\n"
+        "for i in range(10):\n"
+        "    store.append(f'child/{i}', b'from-child-%d' % i)\n"
+        "print(len(store.live_names()))\n"
+    )
+    result = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=60, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert result.returncode == 0, result.stderr
+    assert result.stdout.strip() == "11"
+    assert len(parent.live_names()) == 11
+    assert parent.read_bytes("child/7") == b"from-child-7"
+
+
+# ---- the O(index) claim: syscalls, not timing ----
+
+def test_namespace_questions_touch_no_dirents(tmp_path, monkeypatch):
+    """list/prefix/index scans over a warm store must be pure index
+    reads: zero listdir/scandir calls (the path store pays one dirent
+    walk per directory; this store's whole point is not to)."""
+    store = store_at(tmp_path)
+    for i in range(50):
+        store.append(f"d{i % 5}/n{i:02d}", b"x" * 20,
+                     hashes=[f"sha256-{i:064d}"],
+                     nodes=[["local", f"/n{i % 3}"]])
+    store.generation()  # warm the index under the real functions
+
+    calls = {"n": 0}
+
+    def counting(real):
+        def inner(*a, **kw):
+            calls["n"] += 1
+            return real(*a, **kw)
+        return inner
+
+    monkeypatch.setattr(os, "listdir", counting(os.listdir))
+    monkeypatch.setattr(os, "scandir", counting(os.scandir))
+
+    assert len(store.live_names()) == 50
+    assert len(store.prefix_names("d3")) == 10
+    assert store.list_children("d0")[0] == "directory"
+    assert len(store.index_meta()) == 50
+    assert store.changes(0, limit=10)
+    assert calls["n"] == 0
+
+
+# ---- index projection fast paths ----
+
+def test_projection_roundtrips_append_compact_and_cold_reload(tmp_path):
+    store = store_at(tmp_path)
+    store.append("a", b"ref-a", hashes=["sha256-" + "0" * 64],
+                 nodes=[["local", "/d0"], ["http", "node:8080"]])
+    rows = store.index_meta()
+    assert rows == [("a", ("sha256-" + "0" * 64,),
+                     (("local", "/d0"), ("http", "node:8080")))]
+    store.compact()
+    assert store.index_meta() == rows
+    assert MetaLogStore(store.root).index_meta() == rows
+
+
+def test_extract_index_meta_from_golden_ref():
+    """The publish-time extractor against a real frozen ref: every
+    chunk hash in display form, every replica's health node key."""
+    import yaml
+
+    from tests.golden import generate as gen
+
+    with open(os.path.join(gen.GOLDEN_DIR,
+                           "cluster_placement.yaml")) as f:
+        payload = yaml.safe_load(f)
+    hashes, nodes = meta_log.extract_index_meta(payload)
+    want = [f"sha256-{c['sha256']}"
+            for part in payload["parts"]
+            for c in part["data"] + part["parity"]]
+    assert hashes == want
+    assert nodes and all(kind == "local" for kind, _node in nodes)
+    # anything that does not parse as a file reference projects to None
+    assert meta_log.extract_index_meta({"foreign": 1}) == (None, None)
+    assert meta_log.extract_index_meta(None) == (None, None)
+
+
+def test_namespace_fast_paths_fall_back_on_missing_projection(tmp_path):
+    """One projection-less live entry poisons the whole fast path to
+    None (scoring/liveness must never be silently partial); deleting
+    it restores the index answer."""
+    metadata = MetadataLog(path=str(tmp_path / "m"))
+    store = metadata.store
+    store.append("a", b"x", hashes=["sha256-" + "1" * 64],
+                 nodes=[["local", "/d0"]])
+
+    async def scan():
+        return (await metadata.namespace_nodes(),
+                await metadata.namespace_hashes())
+
+    nodes, hashes = asyncio.run(scan())
+    assert nodes == [("a", (("local", "/d0"),))]
+    assert hashes == [("a", ("sha256-" + "1" * 64,))]
+
+    store.append("foreign", b"y")  # no projection
+    nodes, hashes = asyncio.run(scan())
+    assert nodes is None and hashes is None
+
+    store.tombstone("foreign")
+    nodes, hashes = asyncio.run(scan())
+    assert nodes is not None and hashes is not None
+
+
+# ---- the async MetadataStore surface ----
+
+def test_metadata_log_store_contract(tmp_path):
+    from chunky_bits_tpu.cluster.metadata import metadata_from_obj
+
+    metadata = metadata_from_obj({"type": "meta-log", "format": "json",
+                                  "path": str(tmp_path / "m")})
+    assert isinstance(metadata, MetadataLog)
+    assert metadata.to_obj() == {"type": "meta-log", "format": "json",
+                                 "path": str(tmp_path / "m")}
+
+    async def roundtrip():
+        await metadata.write("dir/a", {"k": 1})
+        await metadata.write("dir/b", {"k": 2})
+        assert await metadata.read("dir/a") == {"k": 1}
+        listed = await metadata.list("dir")
+        assert [(e.kind, e.path) for e in listed] \
+            == [("directory", "dir"), ("file", "dir/a"),
+                ("file", "dir/b")]
+        assert await metadata.list_files_recursive() == ["dir/a", "dir/b"]
+        # read_objs: input order, unknown names skipped, one batch
+        objs = await metadata.read_objs(["dir/b", "ghost", "dir/a"])
+        assert objs == [("dir/b", {"k": 2}), ("dir/a", {"k": 1})]
+        await metadata.delete("dir/a")
+        with pytest.raises(MetadataReadError):
+            await metadata.read("dir/a")
+        snap = await metadata.namespace_snapshot()
+        assert snap == [("dir/b", {"k": 2})]
+
+    asyncio.run(roundtrip())
+
+
+def test_env_override_rebuilds_path_stores(tmp_path, monkeypatch):
+    from chunky_bits_tpu.cluster import tunables
+    from chunky_bits_tpu.cluster.metadata import (
+        MetadataPath,
+        metadata_from_obj,
+    )
+
+    spec = {"type": "path", "path": str(tmp_path / "m")}
+    monkeypatch.setenv(tunables.METADATA_KIND_ENV, "meta-log")
+    assert isinstance(metadata_from_obj(dict(spec)), MetadataLog)
+    # put_script stores silently stay path: the log has no write hook
+    assert isinstance(
+        metadata_from_obj(dict(spec, put_script="true")), MetadataPath)
+    # anything but the shipped override value reads as no override
+    monkeypatch.setenv(tunables.METADATA_KIND_ENV, "bogus")
+    assert isinstance(metadata_from_obj(dict(spec)), MetadataPath)
+
+
+def test_generation_fencing_vs_file_ref_lru(tmp_path):
+    """The cluster's parsed-ref LRU (cache_bytes on) must never serve
+    a ref superseded through the meta-log store: the write path's
+    generation bump evicts, and a second cluster over the same root
+    sees the new ref through the store's own journal refresh."""
+    from chunky_bits_tpu.cluster.cluster import Cluster
+    from chunky_bits_tpu.utils import aio
+
+    for i in range(3):
+        os.makedirs(tmp_path / f"ssd{i}")
+    spec = {
+        "destinations": {"ssd": [{"location": str(tmp_path / f"ssd{i}")}
+                                 for i in range(3)]},
+        "metadata": {"type": "meta-log", "format": "yaml",
+                     "path": str(tmp_path / "meta")},
+        "profiles": {"default": {
+            "data": 2, "parity": 1, "chunk_size": 12,
+            "rules": {"ssd": {"minimum": 0, "maximum": None,
+                              "ideal": 3}}}},
+        "tunables": {"cache_bytes": 1 << 20},
+    }
+
+    async def run():
+        writer = Cluster.from_obj(spec)
+        reader = Cluster.from_obj(spec)
+        await writer.write_file("f", aio.BytesReader(b"one" * 400),
+                                writer.get_profile())
+        first = await reader.get_file_ref("f")
+        assert await reader.get_file_ref("f") is first  # LRU hit
+        await writer.write_file("f", aio.BytesReader(b"two" * 500),
+                                writer.get_profile())
+        # the writer's own cache was fenced by the generation bump
+        assert await writer.get_file_ref("f") is not first
+        # the reader cluster still holds the stale parse (its LRU was
+        # never fenced — same behavior as the path store); dropping the
+        # cached entry forces a store read, which must see the new
+        # journal tail another PROCESS-shaped instance appended
+        reader._file_refs.clear()
+        reader._file_ref_gen += 1
+        fresh = await reader.get_file_ref("f")
+        assert fresh.length == 1500
+        stream = await reader.read_file("f")
+        chunks = []
+        while True:
+            piece = await stream.read(1 << 16)
+            if not piece:
+                break
+            chunks.append(piece)
+        assert b"".join(chunks) == b"two" * 500
+
+    asyncio.run(run())
