@@ -64,6 +64,35 @@ rule's full rationale, ``--graph-stats`` reports graph precision):
   functions that feed parameters into ``.labels()``   -> CB305
   ``label-flow``
 
+CB4xx — resource lifetime & deadline propagation (lifetime.py over
+statement-granular CFGs from cfg.py: explicit exception/finally/
+with-unwind edges plus await-as-cancellation-point edges, a worklist
+may/must dataflow engine, per-function summaries composed through the
+shared call graph; ``--select CB4`` runs the family alone):
+
+- leak-strict extends to EVERY path out of a function:
+  an acquired fd/socket/mmap is closed, returned,
+  stored, or handed off even when a statement between
+  acquire and release raises or is cancelled          -> CB401
+  ``fd-leak``
+- a manual lock/flock acquire reaches its release on
+  all paths (an exception between them deadlocks
+  every later taker)                                  -> CB402
+  ``lock-discipline``
+- CFG-precise task custody: an ASSIGNED task can
+  still lose its owner when the path between spawn
+  and await raises; cancel() alone observes nothing   -> CB403
+  ``task-custody``
+- degrade-never-hang, interprocedurally: serving-
+  plane paths into modules off CB101's list still
+  need a deadline at SOME frame (wait_for at the
+  call site bounds everything beneath)                -> CB404
+  ``unbounded-deadline``
+- scrub/repair I/O is exactly metered: every read/
+  write dominated by its own bucket.take() charge,
+  caller-side charges compose through summaries       -> CB405
+  ``metered-io``
+
 The runtime side of the same contract lives in ``sanitizer.py``: an
 opt-in (``$CHUNKY_BITS_TPU_SANITIZE``) loop-stall watchdog, task-leak
 registry, and HostPipeline handoff checker.  It is deliberately NOT

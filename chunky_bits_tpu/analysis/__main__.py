@@ -48,6 +48,11 @@ def main(argv: list[str] | None = None) -> int:
         "--write-baseline", action="store_true",
         help="accept all current findings into the baseline and exit 0")
     parser.add_argument(
+        "--prune-baseline", action="store_true",
+        help="rewrite the baseline keeping only entries that still "
+             "match a finding (drops stale accepts; migrates legacy "
+             "fingerprints to scoped ones); never adds entries")
+    parser.add_argument(
         "--select", default="",
         help="comma-separated rule ids or family prefixes to run "
              "(e.g. CB101,CB104 — or CB2 for the whole CB2xx family)")
@@ -60,8 +65,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--graph-stats", action="store_true",
         help="also report call-graph statistics (functions/edges/"
-             "worker roots/unknown-edge count) so graph precision "
-             "regressions show up in the lint report")
+             "worker roots/unknown-edge count) and CFG totals "
+             "(functions/blocks/edges/dataflow summaries) so graph "
+             "precision regressions show up in the lint report")
     parser.add_argument(
         "--explain", metavar="RULE",
         help="print the full rationale + fix pattern for a rule id, "
@@ -154,6 +160,30 @@ def main(argv: list[str] | None = None) -> int:
               f"{args.baseline}")
         return 0
 
+    if args.prune_baseline:
+        # same refusal logic as --write-baseline: a restricted or
+        # error-laden scan cannot distinguish "stale" from "not
+        # scanned", and pruning on it would drop live accepts
+        if args.select or files is not None:
+            parser.error("--prune-baseline requires a full scan "
+                         "(drop --select and explicit paths)")
+        if errors:
+            for err in errors:
+                print(f"ERROR {err}", file=sys.stderr)
+            parser.error("--prune-baseline refused: the scan had file "
+                         "errors (fix them first)")
+        try:
+            baseline = load_baseline(args.baseline)
+        except ValueError as err:
+            parser.error(str(err))
+        kept = [v for v in violations if set(v.keys()) & baseline]
+        matched = baseline & {k for v in kept for k in v.keys()}
+        dropped = len(baseline) - len(matched)
+        write_baseline(args.baseline, kept)
+        print(f"kept {len(kept)} accepted finding(s), dropped "
+              f"{dropped} stale entr(y/ies) in {args.baseline}")
+        return 0
+
     try:
         baseline = set() if args.no_baseline \
             else load_baseline(args.baseline)
@@ -212,7 +242,11 @@ def main(argv: list[str] | None = None) -> int:
         summary += (f"; graph: {stats.get('functions', 0)} functions, "
                     f"{stats.get('edges', 0)} edges, "
                     f"{stats.get('worker_roots', 0)} worker roots, "
-                    f"{stats.get('unknown_edges', 0)} unknown edges")
+                    f"{stats.get('unknown_edges', 0)} unknown edges"
+                    f"; cfg: {stats.get('cfg_functions', 0)} functions, "
+                    f"{stats.get('cfg_blocks', 0)} blocks, "
+                    f"{stats.get('cfg_edges', 0)} edges, "
+                    f"{stats.get('dataflow_summaries', 0)} summaries")
     if new or errors:
         print(f"FAIL: {summary}")
         return 1

@@ -266,6 +266,7 @@ def run_analysis(root: Path, rules: Iterable[object],
             raw_by_rel[rel].append((rule, line, col, message))
     if stats is not None and ctx is not None:
         stats.update(ctx.graph.stats())
+        stats.update(ctx.cfg_stats())
     for sf in sources:
         raw = raw_by_rel[sf.rel]
         # occurrence index among same (rule, scope, snippet) triples in

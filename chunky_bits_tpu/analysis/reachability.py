@@ -29,6 +29,12 @@ class ProjectContext:
         self._sources = list(sources)
         self._graph: Optional[CallGraph] = None
         self._reach_cache: dict[frozenset, frozenset] = {}
+        #: function key -> built CFG (the CB4xx rules share one graph
+        #: per function however many rules query it)
+        self._cfg_cache: dict = {}
+        #: interprocedural summary tags recorded by dataflow rules —
+        #: counted into ``--graph-stats``
+        self._summaries: set = set()
         #: rel -> SourceFile, for rules that need suppression scans
         self.by_rel = {sf.rel: sf for sf in self._sources}
 
@@ -67,6 +73,32 @@ class ProjectContext:
             cached = frozenset(self.graph.reachable(key))
             self._reach_cache[key] = cached
         return cached
+
+    def cfg_of(self, info: FuncInfo):
+        """Memoized statement-granular CFG for one function (built by
+        ``analysis.cfg.build_cfg``; shared across every CB4xx rule)."""
+        cfg = self._cfg_cache.get(info.key)
+        if cfg is None:
+            from .cfg import build_cfg
+            cfg = build_cfg(info.node)
+            self._cfg_cache[info.key] = cfg
+        return cfg
+
+    def note_summary(self, tag) -> None:
+        """Record one composed per-function dataflow summary (an opaque
+        hashable tag) for the ``--graph-stats`` report."""
+        self._summaries.add(tag)
+
+    def cfg_stats(self) -> dict[str, int]:
+        """CFG-layer totals for ``--graph-stats`` (zeroes until a CB4xx
+        rule has run and populated the caches)."""
+        cfgs = self._cfg_cache.values()
+        return {
+            "cfg_functions": len(self._cfg_cache),
+            "cfg_blocks": sum(c.n_nodes for c in cfgs),
+            "cfg_edges": sum(c.n_edges for c in cfgs),
+            "dataflow_summaries": len(self._summaries),
+        }
 
     def reachable_infos(self, roots: Iterable[tuple[str, str]]
                         ) -> list[FuncInfo]:

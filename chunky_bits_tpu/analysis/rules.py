@@ -658,14 +658,24 @@ FAMILY_HAZARDS = {
               "CB108/CB109 path lists, cancellation safety, sim-plane "
               "purity, label flow across call sites — all over the "
               "function-granular call graph (analysis/callgraph.py)"),
+    "CB4xx": ("resource lifetime & deadline propagation over "
+              "statement-granular CFGs with exception/finally/"
+              "cancellation edges (analysis/cfg.py) and gen/kill "
+              "dataflow, summaries composed through the call graph: "
+              "handles closed on every path, locks always released, "
+              "tasks always owned, awaits bounded at some frame, "
+              "scrub/repair I/O charged before it happens"),
 }
 
-# imported at the bottom: concurrency.py and flow.py need Rule defined
-# first
+# imported at the bottom: concurrency.py, flow.py and lifetime.py need
+# Rule defined first
 from chunky_bits_tpu.analysis.concurrency import (  # noqa: E402
     CONCURRENCY_RULES,
 )
 from chunky_bits_tpu.analysis.flow import FLOW_RULES  # noqa: E402
+from chunky_bits_tpu.analysis.lifetime import (  # noqa: E402
+    LIFETIME_RULES,
+)
 
 ALL_RULES: tuple[Rule, ...] = (
     UnboundedAwaitRule(),
@@ -677,4 +687,4 @@ ALL_RULES: tuple[Rule, ...] = (
     MetricLabelCardinalityRule(),
     ClockSeamRule(),
     FsioSeamRule(),
-) + CONCURRENCY_RULES + FLOW_RULES
+) + CONCURRENCY_RULES + FLOW_RULES + LIFETIME_RULES

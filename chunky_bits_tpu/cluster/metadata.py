@@ -253,6 +253,10 @@ class MetadataPath:
         if self.put_script:
             proc = await asyncio.create_subprocess_shell(
                 self.put_script, cwd=self.path)
+            # lint: unbounded-deadline-ok user-supplied local hook; a
+            # timeout here would orphan a zombie and ack the write with
+            # the hook's outcome unknown — runaway hooks are the
+            # operator's contract (reference parity: put_script blocks)
             code = await proc.wait()
             if self.fail_on_script_error and code != 0:
                 # Distinguish signal-death from a nonzero exit like the
@@ -322,6 +326,9 @@ class MetadataGit:
     async def _git(self, *args: str) -> None:
         proc = await asyncio.create_subprocess_exec(
             "git", *args, cwd=self.meta_path.path)
+        # lint: unbounded-deadline-ok local git child on a local repo;
+        # abandoning wait() would leak a zombie and race the next
+        # add/commit against this one's index lock
         code = await proc.wait()
         if code != 0:
             raise MetadataReadError(f"git {args[0]} exited with {code}")

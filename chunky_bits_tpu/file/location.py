@@ -167,18 +167,19 @@ class LocationContext:
                     await sess.close()
 
         gen = _closer()
-        # Prime it so the loop tracks the generator and finalizes it at
-        # shutdown_asyncgens.  The cache entry holds the strong ref:
-        # the loop's own asyncgen registry is a WeakSet, and an
-        # unreferenced suspended generator would be GC-finalized — and
-        # close the session — while the loop is still serving.
-        primer = asyncio.ensure_future(gen.__anext__())
         # entries for dead loops can't be awaited-closed anymore; sweep
         # them here so a long-lived process running many short loops
         # doesn't pin one (ref, session, gen) tuple per dead loop
         for key, (ref, _s, _g, _p) in list(self._sessions.items()):
             if ref() is None:
                 del self._sessions[key]
+        # Prime it so the loop tracks the generator and finalizes it at
+        # shutdown_asyncgens.  The cache entry holds the strong ref
+        # (stored on the very next line so no statement can strand the
+        # primer): the loop's own asyncgen registry is a WeakSet, and an
+        # unreferenced suspended generator would be GC-finalized — and
+        # close the session — while the loop is still serving.
+        primer = asyncio.ensure_future(gen.__anext__())
         self._sessions[id(loop)] = (weakref.ref(loop), sess, gen, primer)
         return sess
 
@@ -194,6 +195,9 @@ class LocationContext:
             # StopAsyncIteration, which must not surface as a
             # never-retrieved task exception
             try:
+                # lint: unbounded-deadline-ok primer is done or was
+                # cancelled two lines up — this await only retrieves
+                # the already-settled outcome
                 await primer
             except (asyncio.CancelledError, StopAsyncIteration):
                 pass
@@ -865,10 +869,12 @@ class Location:
             if start < 0 or (rng.length is not None and rng.length < 0):
                 # negative ranges: the generic path owns the error
                 # (Python slicing would silently serve bytes from EOF)
+                mm.close()
                 return None
             end = len(mm) if rng.length is None else start + rng.length
             if end > len(mm) or start > len(mm):
                 # short range / zero-extension: generic path semantics
+                mm.close()
                 return None
             if health is not None:
                 # a None return above is "fast path doesn't apply", not

@@ -126,14 +126,19 @@ class GatewaySupervisor:
         on a fleet that never comes up — a half-dead start must fail
         loudly, not serve at reduced capacity silently."""
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
         try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
             sock.bind((self.host, self.port))
-        except OSError as err:
+        except BaseException as err:
+            # close on ANY setup failure (setsockopt included), not
+            # just the bind OSError the old shape guarded
             sock.close()
-            raise ChunkyBitsError(
-                f"cannot bind {self.host}:{self.port}: {err}") from err
+            if isinstance(err, OSError):
+                raise ChunkyBitsError(
+                    f"cannot bind {self.host}:{self.port}: {err}"
+                ) from err
+            raise
         self._placeholder = sock
         self.port = sock.getsockname()[1]
         self.metrics_spool = await asyncio.to_thread(
@@ -426,6 +431,9 @@ async def _worker_amain(spec: dict) -> None:
         print(f"{READY_MARKER} port={bound_port} pid={os.getpid()}",
               flush=True)
 
+    # lint: task-custody-ok cancelled-and-awaited in the finally below;
+    # the only statement before the try is ensure_future(stop.wait()),
+    # which cannot raise
     serve_task = asyncio.ensure_future(serve(
         cluster, host=spec["host"], port=spec["port"], workers=1,
         reuse_port=True, on_ready=announce,

@@ -157,13 +157,59 @@ def _cse(rows: list[set], n_in: int,
     return temps, next_id - n_in
 
 
+def verify_schedule(sched: XorSchedule, mat: np.ndarray) -> None:
+    """Symbolic proof that ``sched`` computes exactly ``mat``.
+
+    Replays the program over GF(2) with each input plane as a basis
+    vector: every arena plane carries its coefficient row over the 8k
+    input planes (COPY assigns the row, XOR adds it mod 2, ZERO clears
+    it — temp-slot recycling falls out naturally since a slot is just
+    whatever row was last written).  After the replay, output plane u
+    must hold row u of ``gf256.expand_to_bit_matrix(mat)`` — the exact
+    math every other backend computes — so a schedule that passes is
+    byte-identical to the table codecs *by construction*, for every
+    shard content, not just the fuzzed ones (2108.02692's verification
+    step).  Raises :class:`ErasureError` on the first mismatching
+    output row; runs at compile time (one [n_planes, 8k] bit matrix,
+    one row op per scheduled op), so the always-on cost rides the slow
+    path that already amortizes behind the ScheduleCache.
+    """
+    m2 = gf256.expand_to_bit_matrix(mat)
+    r8, k8 = m2.shape
+    if (r8, k8) != (8 * sched.r, 8 * sched.k):
+        raise ErasureError(
+            f"schedule geometry {sched.r}x{sched.k} does not match "
+            f"matrix bit-expansion {r8 // 8}x{k8 // 8}")
+    sym = np.zeros((sched.n_planes, k8), dtype=np.uint8)
+    sym[:k8] = np.eye(k8, dtype=np.uint8)
+    for dst, src, kind in sched.ops.tolist():
+        if kind == OP_COPY:
+            sym[dst] = sym[src]
+        elif kind == OP_XOR:
+            sym[dst] ^= sym[src]
+        elif kind == OP_ZERO:
+            sym[dst] = 0
+        else:
+            raise ErasureError(f"unknown op kind {kind} in schedule")
+    got = sym[sched.out_base:]
+    if not np.array_equal(got, m2):
+        bad = int(np.nonzero((got != m2).any(axis=1))[0][0])
+        raise ErasureError(
+            f"xor schedule miscompiles matrix {sched.digest.hex()[:16]}: "
+            f"output plane {bad} (row {bad // 8} bit {bad % 8}) computes "
+            "a different GF(2) combination than the bit-matrix row — "
+            "refusing to cache a program that would fork the wire format")
+
+
 def build_schedule(mat: np.ndarray,
                    max_temps: int = MAX_TEMPS) -> XorSchedule:
     """Compile ``mat`` (uint8 [r, k], r >= 1) into an :class:`XorSchedule`.
 
     The program computes ``out[i] = XOR_j mat[i, j] (x) shards[j]`` in
     bit-plane layout; identity rows become single copies, zero rows an
-    OP_ZERO (decode matrices contain both).
+    OP_ZERO (decode matrices contain both).  Every build is verified
+    symbolically (:func:`verify_schedule`) before the schedule escapes
+    to a caller or the cache.
     """
     mat = np.ascontiguousarray(mat, dtype=np.uint8)
     if mat.ndim != 2 or mat.shape[0] < 1 or mat.shape[1] < 1:
@@ -250,7 +296,9 @@ def build_schedule(mat: np.ndarray,
               kind)
              for d, s, kind in remapped]
     arr = np.ascontiguousarray(np.array(final, dtype=np.int32))
-    return XorSchedule(k, r, n_slots, arr, raw_xors, digest)
+    sched = XorSchedule(k, r, n_slots, arr, raw_xors, digest)
+    verify_schedule(sched, mat)
+    return sched
 
 
 class ScheduleCache:

@@ -190,6 +190,9 @@ async def close_reader(reader) -> None:
     if close is not None:
         result = close()
         if hasattr(result, "__await__"):
+            # lint: unbounded-deadline-ok reader close releases local
+            # fds / returns pooled connections — no network round-trip;
+            # bounding it would strand the resource it exists to free
             await result
 
 
@@ -380,6 +383,9 @@ async def copy_reader_to_file(reader: AsyncByteReader, path: str,
                 pending = None
             if not data:
                 break
+            # lint: task-custody-ok awaited at the loop head or gathered
+            # in the finally; the dataflow cannot correlate the
+            # `pending is not None` guard with this assignment
             pending = asyncio.ensure_future(
                 asyncio.to_thread(f.write, data))
             total += len(data)
@@ -406,6 +412,9 @@ async def copy_reader_to_writer(reader: AsyncByteReader, write,
                 pending = None
             if not data:
                 break
+            # lint: task-custody-ok awaited at the loop head or in the
+            # finally; the dataflow cannot correlate the
+            # `pending is not None` guard with this assignment
             pending = asyncio.ensure_future(write(data))
             total += len(data)
     finally:

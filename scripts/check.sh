@@ -5,21 +5,24 @@
 #   1. the project-native invariant linter (chunky_bits_tpu/analysis):
 #      pure stdlib AST rules, NO jax/numpy/aiohttp import, so it runs
 #      even when the device tunnel is down and on bare runners.  Always
-#      BLOCKING.  Covers both families: CB1xx single-function
-#      invariants and the CB2xx concurrency-hazard rules (blocking
-#      calls in async defs, locks across awaits, leaked tasks, the
-#      cross-plane call-graph pass, loop-shared state); run one family
-#      alone with `python -m chunky_bits_tpu.analysis --select CB2`.
+#      BLOCKING.  Covers all four families: CB1xx single-function
+#      invariants, the CB2xx concurrency-hazard rules (blocking calls
+#      in async defs, locks across awaits, leaked tasks, the
+#      cross-plane call-graph pass, loop-shared state), the CB3xx
+#      whole-program reachability rules, and the CB4xx resource-
+#      lifetime/deadline rules (CFG + dataflow: fd/lock/task leaks on
+#      exception and cancellation paths, interprocedural deadline and
+#      scrub-metering proofs); run one family alone with
+#      `python -m chunky_bits_tpu.analysis --select CB4`.
 #   2. mypy over the strict-typed surfaces ([tool.mypy] in
 #      pyproject.toml) — only when mypy is installed, and ADVISORY by
-#      default (MYPY_STRICT=1 makes it blocking).  The dev image cannot
-#      install mypy, so this half has never produced a recorded green
-#      run; until one exists it must not make THE gate fail on the one
-#      box that happens to have mypy while staying green everywhere
-#      else.  Flip the default to blocking once CI's mypy step records
-#      green.  Lint rule CB106 enforces annotation presence on the same
-#      modules regardless, so the typing floor never silently
-#      disappears with the tool.
+#      default (MYPY_STRICT=1 makes it blocking; CI's mypy step sets
+#      it and is a blocking job, so the typed surfaces DO gate merges
+#      — the env default only spares dev boxes that happen to carry a
+#      mismatched mypy).  The dev image cannot install mypy at all, so
+#      there this half skips with a note.  Lint rule CB106 enforces
+#      annotation presence on the same modules regardless, so the
+#      typing floor never silently disappears with the tool.
 #
 # Exit code: non-zero when the linter fails (or mypy fails under
 # MYPY_STRICT=1).

@@ -1052,15 +1052,18 @@ def test_cli_list_rules_names_every_rule_grouped_by_family():
     for rid in ("CB101", "CB102", "CB103", "CB104", "CB105", "CB106",
                 "CB107", "CB108", "CB109",
                 "CB201", "CB202", "CB203", "CB204", "CB205",
-                "CB301", "CB302", "CB303", "CB304", "CB305"):
+                "CB301", "CB302", "CB303", "CB304", "CB305",
+                "CB401", "CB402", "CB403", "CB404", "CB405"):
         assert rid in proc.stdout
     # family grouping with one-line hazard descriptions
     assert "CB1xx — " in proc.stdout
     assert "CB2xx — " in proc.stdout
     assert "CB3xx — " in proc.stdout
+    assert "CB4xx — " in proc.stdout
     assert proc.stdout.index("CB1xx") < proc.stdout.index("CB101")
     assert proc.stdout.index("CB2xx") < proc.stdout.index("CB201")
     assert proc.stdout.index("CB3xx") < proc.stdout.index("CB301")
+    assert proc.stdout.index("CB4xx") < proc.stdout.index("CB401")
 
 
 def test_cli_select_family_prefix():
@@ -1795,3 +1798,624 @@ def test_analyzer_runtime_budget():
     elapsed = _time.monotonic() - t0
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert elapsed < 15.0, f"analysis took {elapsed:.1f}s"
+
+
+# ---- CB4xx resource-lifetime & deadline-propagation family ----
+
+def _cfg_of(source: str):
+    """Build the CFG of the first function in ``source``."""
+    import ast
+
+    from chunky_bits_tpu.analysis import cfg as cfgmod
+
+    fn = ast.parse(textwrap.dedent(source)).body[0]
+    return cfgmod.build_cfg(fn)
+
+
+def _kinds(cfg) -> list:
+    return cfg.kinds
+
+
+def test_cfg_try_finally_edges():
+    """Every way out of the try (fall-through, body exception, handler
+    exception) runs the finally, and the finally's exits propagate the
+    exceptional continuation outward."""
+    from chunky_bits_tpu.analysis import cfg as cfgmod
+
+    cfg = _cfg_of("""
+        def f(a):
+            try:
+                a.work()
+            finally:
+                a.cleanup()
+            return a
+    """)
+    assert cfgmod.K_FINPAD in cfg.kinds
+    pad = cfg.kinds.index(cfgmod.K_FINPAD)
+    import ast as _ast
+    work = next(i for i, s in enumerate(cfg.stmts)
+                if s is not None and isinstance(s, _ast.Expr)
+                and "work" in _ast.dump(s))
+    cleanup = next(i for i, s in enumerate(cfg.stmts)
+                   if s is not None and isinstance(s, _ast.Expr)
+                   and "cleanup" in _ast.dump(s))
+    # body exception lands on the finally pad, not the raise exit
+    assert cfg.exc[work] == {pad}
+    assert pad in cfg.flow[work]  # fall-through also runs the finally
+    assert cleanup in cfg.flow[pad]
+    # the finally may be completing an exceptional path: its exit
+    # nodes carry an exc edge outward
+    assert cfg.raise_exit in cfg.exc[cleanup]
+
+
+def test_cfg_with_unwind_and_await_cancellation_edges():
+    """A with-body statement's exception unwinds through __exit__ (its
+    exc edge), and EVERY await carries an exc edge — cancellation can
+    surface at any suspension point even with nothing else to fail."""
+    import ast as _ast
+
+    cfg = _cfg_of("""
+        async def f(cm, t):
+            with cm:
+                await t
+    """)
+    aw = next(i for i, s in enumerate(cfg.stmts)
+              if s is not None and isinstance(s, _ast.Expr))
+    assert cfg.raise_exit in cfg.exc[aw]
+    # a bare await of a plain name: no call anywhere, still an exc
+    # edge (the await-as-cancellation-point rule)
+    cfg2 = _cfg_of("""
+        async def g(t):
+            await t
+    """)
+    aw2 = next(i for i, s in enumerate(cfg2.stmts)
+               if s is not None and isinstance(s, _ast.Expr))
+    assert cfg2.raise_exit in cfg2.exc[aw2]
+
+
+def test_cfg_loop_orelse_break_continue():
+    """break exits past the orelse, continue returns to the header,
+    orelse runs only on normal loop exhaustion."""
+    import ast as _ast
+
+    cfg = _cfg_of("""
+        def f(xs):
+            for x in xs:
+                if x:
+                    break
+                continue
+            else:
+                tail()
+            return 1
+    """)
+    header = next(i for i, s in enumerate(cfg.stmts)
+                  if isinstance(s, _ast.For))
+    brk = next(i for i, s in enumerate(cfg.stmts)
+               if isinstance(s, _ast.Break))
+    cont = next(i for i, s in enumerate(cfg.stmts)
+                if isinstance(s, _ast.Continue))
+    ret = next(i for i, s in enumerate(cfg.stmts)
+               if isinstance(s, _ast.Return))
+    orelse = next(i for i, s in enumerate(cfg.stmts)
+                  if s is not None and isinstance(s, _ast.Expr)
+                  and "tail" in _ast.dump(s))
+    assert cfg.flow[cont] == {header}
+    assert ret in cfg.flow[brk]        # break skips the orelse
+    assert orelse not in cfg.flow[brk]
+    assert orelse in cfg.flow[header]  # exhaustion runs the orelse
+    assert ret in cfg.flow[orelse]
+
+
+def test_cfg_while_true_only_exits_via_break():
+    cfg = _cfg_of("""
+        def f(q):
+            while True:
+                if q.done():
+                    break
+        """)
+    import ast as _ast
+    brk = next(i for i, s in enumerate(cfg.stmts)
+               if isinstance(s, _ast.Break))
+    header = next(i for i, s in enumerate(cfg.stmts)
+                  if isinstance(s, _ast.While))
+    # the header has no normal exit edge to the function exit — only
+    # the break reaches it
+    assert cfg.exit not in cfg.flow[header]
+    assert cfg.exit in cfg.flow[brk]
+
+
+def test_cfg_dataflow_may_vs_must():
+    """The engine's two meets on one diamond: a fact genned on one
+    branch MAY reach the join but is not a MUST there."""
+    import ast as _ast
+
+    from chunky_bits_tpu.analysis.cfg import dataflow
+
+    cfg = _cfg_of("""
+        def f(c):
+            if c:
+                x = acquire()
+            return x
+    """)
+    acq = next(i for i, s in enumerate(cfg.stmts)
+               if isinstance(s, _ast.Assign))
+    ret = next(i for i, s in enumerate(cfg.stmts)
+               if isinstance(s, _ast.Return))
+    gen = [frozenset()] * cfg.n_nodes
+    kill = [frozenset()] * cfg.n_nodes
+    gen[acq] = frozenset({"x"})
+    may = dataflow(cfg, gen, kill)
+    must = dataflow(cfg, gen, kill, must=True)
+    assert "x" in may[ret]
+    assert must[ret] is not None and "x" not in must[ret]
+
+
+# -- CB401 fd-leak --
+
+def test_fd_leak_flags_unguarded_open(tmp_path):
+    """The PR 10 shape: a statement between open and the custody
+    transfer can raise (or the await can be cancelled), orphaning f."""
+    vs = run_tree(tmp_path, {
+        "utils/u.py": """
+            def f(path, n):
+                f = open(path, "rb")
+                f.seek(n)
+                return f
+        """,
+    }, select=("CB401",))
+    assert [v.rule for v in vs] == ["CB401"]
+    assert "exception/cancellation path" in vs[0].message
+    assert "f = open()" in vs[0].message
+
+
+def test_fd_leak_passes_opener_guard_and_with(tmp_path):
+    """The two sanctioned shapes: the try/except-BaseException opener
+    guard (utils/aio.py FileReader._ensure) and plain `with`."""
+    vs = run_tree(tmp_path, {
+        "utils/u.py": """
+            def guarded(path, n):
+                f = open(path, "rb")
+                try:
+                    f.seek(n)
+                except BaseException:
+                    f.close()
+                    raise
+                return f
+
+            def scoped(path):
+                with open(path, "rb") as f:
+                    return f.read()
+        """,
+    }, select=("CB401",))
+    assert vs == []
+
+
+def test_fd_leak_negative_control_open_in_thread_reaper(tmp_path):
+    """The exact aio.open_in_thread opener contract, both ways: with
+    the reaper guard the opener is clean; DELETE the guard and CB401
+    must catch the orphaned handle on the cancellation path — proving
+    the rule would have caught the PR 10 bug before the soak did."""
+    guarded = """
+        def _open(path, off):
+            f = open(path, "rb")
+            try:
+                if off:
+                    f.seek(off)
+            except BaseException:
+                f.close()
+                raise
+            return f
+    """
+    reaper_deleted = """
+        def _open(path, off):
+            f = open(path, "rb")
+            if off:
+                f.seek(off)
+            return f
+    """
+    assert run_tree(tmp_path, {"utils/a.py": guarded},
+                    select=("CB401",)) == []
+    vs = run_tree(tmp_path, {"utils/b.py": reaper_deleted},
+                  select=("CB401",))
+    assert [v.rule for v in vs] == ["CB401"]
+
+
+def test_fd_leak_custody_transfers_pass(tmp_path):
+    """Handing the handle to a callee, storing it through an attribute
+    or into a container (even inside a tuple), yielding it — all
+    custody transfers, not leaks."""
+    vs = run_tree(tmp_path, {
+        "utils/u.py": """
+            def to_callee(path, sink):
+                f = open(path, "rb")
+                sink(f)
+
+            class Holder:
+                def stash(self, path):
+                    f = open(path, "rb")
+                    self._f = f
+
+                def index(self, path, k):
+                    f = open(path, "rb")
+                    self._m[k] = (path, f)
+
+            def gen(path):
+                f = open(path, "rb")
+                yield f
+        """,
+    }, select=("CB401",))
+    assert vs == []
+
+
+def test_fd_leak_socket_mmap_and_fsio_open_tracked(tmp_path):
+    vs = run_tree(tmp_path, {
+        "utils/u.py": """
+            import socket
+            import mmap
+
+            def s():
+                sock = socket.socket()
+                sock.connect(("h", 1))
+                return sock
+
+            def m(f):
+                mm = mmap.mmap(f.fileno(), 0)
+                if mm.size() == 0:
+                    return None
+                return mm
+        """,
+    }, select=("CB401",))
+    assert sorted(v.message.split(" = ")[0].split()[-1] for v in vs) \
+        == ["mm", "sock"]
+
+
+def test_fd_leak_close_methods_exempt(tmp_path):
+    """close()/__exit__ implementations ARE the release — the split
+    halves must not self-flag."""
+    vs = run_tree(tmp_path, {
+        "utils/u.py": """
+            class R:
+                def close(self):
+                    f = open(self._path, "rb")
+                    f.flush()
+        """,
+    }, select=("CB401",))
+    assert vs == []
+
+
+# -- CB402 lock-discipline --
+
+def test_lock_discipline_flags_unpaired_acquire(tmp_path):
+    vs = run_tree(tmp_path, {
+        "utils/u.py": """
+            def f(lock, work):
+                lock.acquire()
+                work()
+                lock.release()
+        """,
+    }, select=("CB402",))
+    assert [v.rule for v in vs] == ["CB402"]
+    assert "deadlock" in vs[0].message
+    assert "with lock:" in vs[0].message
+
+
+def test_lock_discipline_passes_finally_and_with(tmp_path):
+    vs = run_tree(tmp_path, {
+        "utils/u.py": """
+            def paired(lock, work):
+                lock.acquire()
+                try:
+                    work()
+                finally:
+                    lock.release()
+
+            def ctx(lock, work):
+                with lock:
+                    work()
+        """,
+    }, select=("CB402",))
+    assert vs == []
+
+
+def test_lock_discipline_flock_pairing(tmp_path):
+    """fcntl.flock: LOCK_EX without LOCK_UN on the exception path
+    flags; the finally-paired shape passes (file/slab.py _Flock's
+    split across __enter__/__exit__ is exempt by function name)."""
+    flagged = run_tree(tmp_path, {
+        "utils/u.py": """
+            import fcntl
+
+            def f(fd, work):
+                fcntl.flock(fd, fcntl.LOCK_EX)
+                work()
+                fcntl.flock(fd, fcntl.LOCK_UN)
+        """,
+    }, select=("CB402",))
+    assert [v.rule for v in flagged] == ["CB402"]
+    clean = run_tree(tmp_path / "b", {
+        "utils/u.py": """
+            import fcntl
+
+            def f(fd, work):
+                fcntl.flock(fd, fcntl.LOCK_EX)
+                try:
+                    work()
+                finally:
+                    fcntl.flock(fd, fcntl.LOCK_UN)
+
+            class _Flock:
+                def __enter__(self):
+                    fcntl.flock(self._fd, fcntl.LOCK_EX)
+
+                def __exit__(self, *exc):
+                    fcntl.flock(self._fd, fcntl.LOCK_UN)
+        """,
+    }, select=("CB402",))
+    assert clean == []
+
+
+# -- CB403 task-custody --
+
+def test_task_custody_flags_assigned_then_leaked(tmp_path):
+    """The shape syntactic CB203 cannot see: the task IS assigned, but
+    an intervening cancellation point exits the scope without it."""
+    vs = run_tree(tmp_path, {
+        "utils/u.py": """
+            import asyncio
+
+            async def f(work, other):
+                t = asyncio.create_task(work())
+                await other()
+                await t
+        """,
+    }, select=("CB403",))
+    assert [v.rule for v in vs] == ["CB403"]
+    assert "exception/cancellation path" in vs[0].message
+
+
+def test_task_custody_passes_owned_shapes(tmp_path):
+    vs = run_tree(tmp_path, {
+        "utils/u.py": """
+            import asyncio
+
+            async def reaped(work, other):
+                t = asyncio.create_task(work())
+                try:
+                    await other()
+                finally:
+                    t.cancel()
+                    await t
+
+            async def gathered(work):
+                t = asyncio.ensure_future(work())
+                await asyncio.gather(t)
+
+            class S:
+                def stored(self, work):
+                    t = asyncio.create_task(work())
+                    self._tasks.add(t)
+
+            async def callbacked(work, reap):
+                t = asyncio.ensure_future(work())
+                t.add_done_callback(reap)
+        """,
+    }, select=("CB403",))
+    assert vs == []
+
+
+def test_task_custody_cancel_alone_is_not_custody(tmp_path):
+    """cancel() only requests — without an await nothing observes the
+    outcome (CB303's point, made path-sensitive)."""
+    vs = run_tree(tmp_path, {
+        "utils/u.py": """
+            import asyncio
+
+            async def f(work):
+                t = asyncio.create_task(work())
+                t.cancel()
+        """,
+    }, select=("CB403",))
+    assert [v.rule for v in vs] == ["CB403"]
+
+
+# -- CB404 unbounded-deadline --
+
+def test_unbounded_deadline_flags_cross_module_bare_await(tmp_path):
+    """The gap CB101's path list leaves: a bare await in a module off
+    the list, reached from a gateway handler."""
+    vs = run_tree(tmp_path, {
+        "gateway/http.py": """
+            from cluster import cluster
+
+            async def handle(req):
+                await cluster.fetch(req)
+        """,
+        "cluster/cluster.py": """
+            async def fetch(req):
+                await req.wait()
+        """,
+    }, select=("CB404",))
+    assert [(v.rule, v.path) for v in vs] == \
+        [("CB404", "cluster/cluster.py")]
+    assert "no deadline at ANY frame" in vs[0].message
+
+
+def test_unbounded_deadline_passes_bound_at_caller(tmp_path):
+    """The converse gap: wait_for at the CALL SITE bounds everything
+    beneath — the callee's bare await is fine on that path."""
+    vs = run_tree(tmp_path, {
+        "gateway/http.py": """
+            import asyncio
+
+            from cluster import cluster
+
+            async def handle(req):
+                await asyncio.wait_for(cluster.fetch(req), 5.0)
+        """,
+        "cluster/cluster.py": """
+            async def fetch(req):
+                await req.wait()
+        """,
+    }, select=("CB404",))
+    assert vs == []
+
+
+def test_unbounded_deadline_unreachable_and_governed_pass(tmp_path):
+    """A bare await nothing serving-rooted reaches is CB101's business
+    (or nobody's); modules CB101 already governs are excluded."""
+    vs = run_tree(tmp_path, {
+        "gateway/http.py": """
+            async def handle(req):
+                return req
+        """,
+        "cluster/cluster.py": """
+            async def orphan(req):
+                await req.wait()
+        """,
+        "ops/pipeline_helper.py": """
+            async def governed(evt):
+                await evt
+        """,
+    }, select=("CB404",))
+    assert vs == []
+
+
+# -- CB405 metered-io --
+
+def test_metered_io_flags_uncharged_read(tmp_path):
+    vs = run_tree(tmp_path, {
+        "cluster/scrub.py": """
+            class ScrubDaemon:
+                async def run(self, loc):
+                    await self._verify(loc)
+
+                async def _verify(self, loc):
+                    data = await loc.read()
+        """,
+    }, select=("CB405",))
+    assert [(v.rule, v.path) for v in vs] == \
+        [("CB405", "cluster/scrub.py")]
+    assert "bucket.take()" in vs[0].message
+
+
+def test_metered_io_passes_local_and_caller_charge(tmp_path):
+    """Charge at the site passes; so does the charge-in-the-caller
+    shape (entered-metered summaries composed through the graph)."""
+    vs = run_tree(tmp_path, {
+        "cluster/scrub.py": """
+            class ScrubDaemon:
+                async def run(self, loc):
+                    await self._bucket.take(8)
+                    data = await loc.read()
+                    await self.bucket.take(8)
+                    await self._helper(loc)
+
+                async def _helper(self, loc):
+                    return await loc.read()
+        """,
+    }, select=("CB405",))
+    assert vs == []
+
+
+def test_metered_io_one_charge_covers_one_io(tmp_path):
+    """Exact metering: take once, read twice — the second read is
+    uncharged and must flag."""
+    vs = run_tree(tmp_path, {
+        "cluster/repair.py": """
+            async def repair_part(bucket, a, b):
+                await bucket.take(8)
+                x = await a.read()
+                y = await b.read()
+        """,
+    }, select=("CB405",))
+    assert len(vs) == 1
+    assert vs[0].line == max(v.line for v in vs)  # the second read
+
+
+def test_metered_io_metadata_plane_exempt(tmp_path):
+    vs = run_tree(tmp_path, {
+        "cluster/scrub.py": """
+            class ScrubDaemon:
+                async def run(self):
+                    refs = await self.metadata.read("ns")
+        """,
+    }, select=("CB405",))
+    assert vs == []
+
+
+# -- family wiring --
+
+def test_cb4_suppression_and_family_select(tmp_path):
+    """Inline suppression works for CFG-rule findings, and --select CB4
+    runs the family alone."""
+    vs = run_tree(tmp_path, {
+        "utils/u.py": """
+            def f(path, n):
+                # lint: fd-leak-ok handed to the caller's reaper registry
+                f = open(path, "rb")
+                f.seek(n)
+                return f
+        """,
+    }, select=("CB401",))
+    assert vs == []
+    proc = _run_cli("--select", "CB4", "--list-rules")
+    assert proc.returncode == 0
+    for rid in ("CB401", "CB402", "CB403", "CB404", "CB405"):
+        assert rid in proc.stdout
+    assert "CB101" not in proc.stdout
+
+
+def test_cb4_shipped_tree_clean_and_graph_stats_grow_cfg():
+    """The family's acceptance criterion: --select CB4 exits 0 on the
+    shipped tree, and --graph-stats reports the CFG layer's totals in
+    both text and JSON."""
+    import json
+
+    proc = _run_cli("--select", "CB4", "--graph-stats", "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    graph = payload["graph"]
+    for key in ("cfg_functions", "cfg_blocks", "cfg_edges",
+                "dataflow_summaries"):
+        assert graph[key] > 0, (key, graph)
+    proc = _run_cli("--select", "CB4", "--graph-stats")
+    assert proc.returncode == 0
+    assert "cfg:" in proc.stdout and "summaries" in proc.stdout
+
+
+def test_cli_prune_baseline_drops_stale_entries(tmp_path):
+    """A deleted violation must not leave a dangling accept: prune
+    rewrites the baseline keeping only entries that still match."""
+    scratch = tmp_path / "pkg"
+    (scratch / "ops").mkdir(parents=True)
+    bad = ("import os\n\n\ndef f():\n"
+           "    return os.environ.get('CHUNKY_BITS_TPU_KNOB')\n")
+    (scratch / "ops" / "m.py").write_text(bad, encoding="utf-8")
+    base = tmp_path / "b.toml"
+    proc = _run_cli("--root", str(scratch), "--baseline", str(base),
+                    "--write-baseline")
+    assert proc.returncode == 0
+    assert core.load_baseline(base)
+    # fix the violation, then prune: the stale accept must vanish
+    (scratch / "ops" / "m.py").write_text(
+        "def f():\n    return None\n", encoding="utf-8")
+    proc = _run_cli("--root", str(scratch), "--baseline", str(base),
+                    "--prune-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "dropped 1" in proc.stdout
+    assert core.load_baseline(base) == set()
+    # and the guards mirror --write-baseline: no partial-scan prunes
+    proc = _run_cli("--root", str(scratch), "--baseline", str(base),
+                    "--select", "CB101", "--prune-baseline")
+    assert proc.returncode == 2
+    assert "full scan" in proc.stderr
+
+
+def test_shipped_baseline_has_no_stale_entries():
+    """CI fails on dangling accepts; this is the same check in-tree."""
+    import json
+
+    proc = _run_cli("--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["stale_baseline_entries"] == 0

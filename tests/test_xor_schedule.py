@@ -337,3 +337,55 @@ def test_golden_fixtures_identical_with_flag_on():
                        capture_output=True, timeout=300)
     assert r.returncode == 0, r.stderr.decode()[-800:]
     assert b"golden ok" in r.stdout
+
+
+# ---- static verifier (compile-time byte-identity proof) ----
+
+def test_verifier_rejects_corrupted_program():
+    """Must-fail control: corrupt ONE op of a valid schedule and the
+    symbolic GF(2) replay must refuse it — the proof actually checks
+    the program, it is not a tautology over the builder's output."""
+    from chunky_bits_tpu.errors import ErasureError
+
+    mat = matrix.build_encode_matrix(4, 2)
+    sched = xor_schedule.build_schedule(mat)  # verified on build
+    xor_schedule.verify_schedule(sched, mat)  # and re-verifiable
+
+    # flip one XOR's source plane to a different input plane
+    bad_ops = np.array(sched.ops, copy=True)
+    xors = np.nonzero(bad_ops[:, 2] == xor_schedule.OP_XOR)[0]
+    assert len(xors), "encode schedule must contain XOR ops"
+    i = int(xors[-1])
+    bad_ops[i, 1] = (bad_ops[i, 1] + 1) % (8 * sched.k)
+    bad = xor_schedule.XorSchedule(sched.k, sched.r, sched.n_temps,
+                                   np.ascontiguousarray(bad_ops),
+                                   sched.raw_xors, sched.digest)
+    with pytest.raises(ErasureError, match="miscompile"):
+        xor_schedule.verify_schedule(bad, mat)
+
+
+def test_verifier_rejects_wrong_matrix():
+    """A schedule verified against a DIFFERENT matrix must fail — the
+    check ties the program to the exact bit expansion, so a cache
+    serving a stale program for a new matrix cannot pass."""
+    from chunky_bits_tpu.errors import ErasureError
+
+    mat_a = matrix.build_encode_matrix(4, 2)
+    mat_b = np.array(mat_a, copy=True)
+    mat_b[0, 0] ^= 1
+    sched = xor_schedule.build_schedule(mat_a)
+    with pytest.raises(ErasureError, match="miscompile"):
+        xor_schedule.verify_schedule(sched, mat_b)
+
+
+def test_verifier_runs_on_every_build_before_caching():
+    """build_schedule itself verifies (the always-on contract): a
+    builder miscompilation can never escape into the ScheduleCache."""
+    import unittest.mock as mock
+
+    mat = matrix.build_encode_matrix(3, 2)
+    with mock.patch.object(xor_schedule, "verify_schedule",
+                           side_effect=AssertionError("called")) as v:
+        with pytest.raises(AssertionError, match="called"):
+            xor_schedule.build_schedule(mat)
+    assert v.called
