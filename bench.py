@@ -3540,6 +3540,281 @@ def bench_mesh_pipeline(argv=()) -> None:
         sys.exit(3)
 
 
+def bench_qos_isolation(argv=()) -> None:
+    """BASELINE.md config 19: the multi-tenant QoS noisy-neighbor A/B
+    (CPU-only, single-process — this box's ~1.35 effective cores make
+    multi-process A/Bs environment-gated, config-9 BASELINE note).
+
+    One in-process gateway (``make_app`` on an AppRunner, tiny
+    ``max_concurrent_gets`` so admission is the contended resource)
+    serves cold-tier reads to TWO tenants telling themselves apart by
+    ``X-Api-Key``: an antagonist fleet that floods continuous GETs,
+    and a victim issuing periodic GETs.  Leg OFF (``qos.enabled:
+    false`` — the pre-QoS gateway) sheds past the bound, so the victim
+    pays 503+retry against the whole flood; leg ON (``qos.enabled:
+    true``, victim weight 4) admits through the weighted-fair
+    scheduler, so the victim queues for roughly one DRR rotation.
+    Reported value: victim time-to-success p99 OFF over ON (>= 1 means
+    QoS helped; the acceptance bar is 5x).  Aggregate throughput of
+    both legs rides along — isolation must not tax total RPS (within
+    10%).  Per-tenant byte identity (every victim body, sampled
+    antagonist bodies, against the numpy source payload) is asserted
+    in-run.
+
+    Flags: ``--antagonists N`` flood size (default 16),
+    ``--reads N`` victim reads per leg (default 40), ``--cap N``
+    max_concurrent_gets (default 4), ``--smoke`` shrinks to a
+    seconds-scale contract check.
+
+    Failure contract (tests/test_bench_outage.py): ANY failure still
+    emits exactly one parseable JSON line and exits 3."""
+    import asyncio
+    import contextlib
+    import os
+    import tempfile
+
+    argv = list(argv)
+
+    def flag(name, default, cast):
+        if name in argv:
+            return cast(argv[argv.index(name) + 1])
+        return default
+
+    metric = "qos_isolation_victim_p99_improvement_d3p2"
+    try:
+        smoke = "--smoke" in argv
+        antagonists = flag("--antagonists", 6 if smoke else 16, int)
+        victim_reads = flag("--reads", 6 if smoke else 40, int)
+        gets_cap = flag("--cap", 4, int)
+        if antagonists <= 0 or victim_reads <= 0 or gets_cap <= 0:
+            raise ValueError(
+                "--antagonists/--reads/--cap must be positive")
+
+        from aiohttp import web
+
+        from chunky_bits_tpu.cluster import Cluster
+        from chunky_bits_tpu.file.profiler import percentile
+        from chunky_bits_tpu.gateway.http import make_app
+        from chunky_bits_tpu.utils import aio
+
+        rng = np.random.default_rng(0)
+        obj_bytes = (64 << 10) if smoke else (512 << 10)
+        chunk_log2 = 12 if smoke else 14
+        payload = rng.integers(0, 256, obj_bytes,
+                               dtype=np.uint8).tobytes()
+        retry_s = 0.02  # victim/antagonist backoff after a 503
+
+        def make_cluster_obj(root: str, qos_on: bool) -> dict:
+            dirs = []
+            for i in range(5):
+                d = os.path.join(root, f"disk{i}")
+                os.makedirs(d, exist_ok=True)
+                dirs.append(d)
+            meta = os.path.join(root, "meta")
+            os.makedirs(meta, exist_ok=True)
+            return {
+                "destinations": [{"location": d} for d in dirs],
+                "metadata": {"type": "path", "format": "yaml",
+                             "path": meta},
+                "profiles": {"default": {"data": 3, "parity": 2,
+                                         "chunk_size": chunk_log2}},
+                # cache far below the object size: every GET pays
+                # fetch+verify, so a slot is held long enough for
+                # admission to be the contended resource
+                "tunables": {
+                    "backend": "native",
+                    "cache_bytes": 1 << 14,
+                    "qos": {
+                        "enabled": qos_on,
+                        "tenants": {
+                            "victim": {"weight": 4,
+                                       "keys": ["victim-key"]},
+                            "antagonist": {
+                                "keys": ["antagonist-key"]},
+                        },
+                    },
+                },
+            }
+
+        class MiniConn:
+            """Raw-socket keep-alive GET client (the config-9 shape):
+            client-side cost stays far below the server's, so the
+            gateway is the measured resource."""
+
+            def __init__(self, port: int):
+                self.port = port
+                self.reader = None
+                self.writer = None
+
+            async def open(self):
+                self.reader, self.writer = \
+                    await asyncio.open_connection("127.0.0.1",
+                                                  self.port)
+                return self
+
+            async def get(self, path: str, extra: str = "") -> tuple:
+                self.writer.write(
+                    (f"GET {path} HTTP/1.1\r\n"
+                     f"Host: 127.0.0.1\r\n{extra}\r\n").encode())
+                await self.writer.drain()
+                status_line = await self.reader.readline()
+                status = int(status_line.split(b" ", 2)[1])
+                length = 0
+                while True:
+                    line = await self.reader.readline()
+                    if line in (b"\r\n", b""):
+                        break
+                    if line[:15].lower() == b"content-length:":
+                        length = int(line[15:])
+                body = b""
+                if status not in (204, 304) and length:
+                    body = await self.reader.readexactly(length)
+                return status, body
+
+            async def close(self):
+                if self.writer is not None:
+                    self.writer.close()
+                    try:
+                        await asyncio.wait_for(
+                            self.writer.wait_closed(), timeout=5)
+                    except (asyncio.TimeoutError, OSError):
+                        pass
+
+        async def run_leg(qos_on: bool) -> dict:
+            with tempfile.TemporaryDirectory() as root:
+                cluster_obj = make_cluster_obj(root, qos_on)
+                seed_cluster = Cluster.from_obj(cluster_obj)
+                profile = seed_cluster.get_profile(None)
+                await seed_cluster.write_file(
+                    "obj", aio.BytesReader(payload), profile)
+                await seed_cluster.tunables.location_context().aclose()
+
+                cluster = Cluster.from_obj(cluster_obj)
+                app = make_app(cluster,
+                               max_concurrent_gets=gets_cap)
+                runner = web.AppRunner(app)
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                port = site._server.sockets[0].getsockname()[1]
+                stop = False
+                counts = {"ok": 0, "shed": 0}
+
+                async def fetch_ok(conn, key: str) -> tuple:
+                    """GET until success; returns (wall_s, body)."""
+                    t0 = time.perf_counter()
+                    while True:
+                        status, body = await conn.get(
+                            "/obj", f"X-Api-Key: {key}\r\n")
+                        if status == 200:
+                            return time.perf_counter() - t0, body
+                        if status != 503:
+                            raise RuntimeError(
+                                f"unexpected status {status}")
+                        counts["shed"] += 1
+                        await asyncio.sleep(retry_s)
+
+                async def antagonist(i: int) -> None:
+                    conn = await MiniConn(port).open()
+                    try:
+                        j = 0
+                        while not stop:
+                            _, body = await fetch_ok(
+                                conn, "antagonist-key")
+                            counts["ok"] += 1
+                            j += 1
+                            if j % 8 == 0:
+                                # sampled antagonist byte identity
+                                assert body == payload, \
+                                    "antagonist byte identity"
+                    finally:
+                        await conn.close()
+
+                tasks = [asyncio.ensure_future(antagonist(i))
+                         for i in range(antagonists)]
+                # let the flood saturate admission first
+                await asyncio.sleep(1.0 if smoke else 2.0)
+                lat: list = []
+                victim_conn = await MiniConn(port).open()
+                t_open = time.perf_counter()
+                try:
+                    for _ in range(victim_reads):
+                        wall, body = await fetch_ok(
+                            victim_conn, "victim-key")
+                        # per-tenant byte identity: every victim body
+                        assert body == payload, "victim byte identity"
+                        counts["ok"] += 1
+                        lat.append(wall)
+                        await asyncio.sleep(0.05)
+                finally:
+                    t_window = time.perf_counter() - t_open
+                    # graceful drain: antagonists finish their
+                    # in-flight request (a mid-request cancel would
+                    # abort server writes into closed sockets)
+                    stop = True
+                    await asyncio.gather(*tasks,
+                                         return_exceptions=True)
+                    await victim_conn.close()
+                    await runner.cleanup()
+                    await cluster.tunables.location_context().aclose()
+                return {
+                    "victim_p50_ms":
+                        percentile(sorted(lat), 50) * 1e3,
+                    "victim_p99_ms":
+                        percentile(sorted(lat), 99) * 1e3,
+                    "ok": counts["ok"],
+                    "shed_503": counts["shed"],
+                    "rps": counts["ok"] / t_window
+                    if t_window > 0 else 0.0,
+                }
+
+        async def run() -> tuple:
+            off = await run_leg(qos_on=False)
+            on = await run_leg(qos_on=True)
+            return off, on
+
+        off, on = asyncio.run(run())
+        improvement = (off["victim_p99_ms"] / on["victim_p99_ms"]
+                       if on["victim_p99_ms"] > 0 else 0.0)
+        rps_ratio = (on["rps"] / off["rps"] if off["rps"] > 0
+                     else 0.0)
+        print(f"# config 19: cap={gets_cap} "
+              f"antagonists={antagonists} reads={victim_reads}: "
+              f"victim p99 OFF {off['victim_p99_ms']:.1f} ms "
+              f"(sheds={off['shed_503']}) vs ON "
+              f"{on['victim_p99_ms']:.1f} ms "
+              f"(sheds={on['shed_503']}) = {improvement:.1f}x; "
+              f"aggregate RPS {off['rps']:.0f} -> {on['rps']:.0f} "
+              f"({rps_ratio:.2f}x)", file=sys.stderr)
+        print(json.dumps({
+            "metric": metric + ("_smoke" if smoke else ""),
+            # the number this config exists for: victim tail latency
+            # with isolation ON vs OFF under the same flood
+            "value": round(improvement, 2),
+            "unit": "x",
+            "vs_baseline": round(improvement, 2),
+            "antagonists": antagonists,
+            "victim_reads": victim_reads,
+            "max_concurrent_gets": gets_cap,
+            "object_bytes": obj_bytes,
+            "off": {k: round(v, 3) if isinstance(v, float) else v
+                    for k, v in off.items()},
+            "on": {k: round(v, 3) if isinstance(v, float) else v
+                   for k, v in on.items()},
+            "aggregate_rps_ratio": round(rps_ratio, 3),
+            "host_cores": nproc(),
+        }))
+    # lint: broad-except-ok the driver contract (ONE parseable JSON
+    # line, always) outranks the traceback; the error text carries it
+    except Exception as err:
+        print(json.dumps({
+            "metric": metric, "value": 0.0, "unit": "x",
+            "vs_baseline": 0.0,
+            "error": f"{type(err).__name__}: {err}",
+        }))
+        sys.exit(3)
+
+
 if __name__ == "__main__":
     # Bench measures the product defaults: the runtime concurrency
     # sanitizer (analysis/sanitizer.py) must stay OFF here even when an
@@ -3569,12 +3844,13 @@ if __name__ == "__main__":
                    "15": lambda: bench_slo_detection(sys.argv),
                    "16": lambda: bench_crash_matrix(sys.argv),
                    "17": lambda: bench_mesh_pipeline(sys.argv),
-                   "18": lambda: bench_meta_log(sys.argv)}
+                   "18": lambda: bench_meta_log(sys.argv),
+                   "19": lambda: bench_qos_isolation(sys.argv)}
         idx = sys.argv.index("--config") + 1
         which = sys.argv[idx] if idx < len(sys.argv) else ""
         if which not in configs:
             print(f"usage: bench.py [--config "
-                  f"{{1,2,3,4,6,7,8,9,10,11,12,13,14,15,16,17,18}}]"
+                  f"{{1,2,3,4,6,7,8,9,10,11,12,13,14,15,16,17,18,19}}]"
                   f" — the device kernel metric (configs 2+3's compute "
                   f"core) is the default no-arg run (got {which!r}); 6 "
                   f"is the hot-read cache A/B, 7 the gateway PUT ingest "
@@ -3589,7 +3865,8 @@ if __name__ == "__main__":
                   f"the crash-consistency matrix suite (all CPU-only), "
                   f"17 the multi-device mesh backend + dispatch-"
                   f"pipeline A/B (virtual CPU mesh by default), 18 the "
-                  f"indexed meta-log vs file-per-ref metadata-plane A/B",
+                  f"indexed meta-log vs file-per-ref metadata-plane "
+                  f"A/B, 19 the multi-tenant QoS noisy-neighbor A/B",
                   file=sys.stderr)
             sys.exit(2)
         configs[which]()

@@ -28,7 +28,7 @@ DEVICE_NET_PATHS = ("ops/", "parallel/", "gateway/", "obs/",
                     "file/file_part.py", "file/slab.py",
                     "cluster/destination.py", "cluster/health.py",
                     "cluster/scrub.py", "cluster/repair.py",
-                    "cluster/meta_log.py")
+                    "cluster/meta_log.py", "cluster/qos.py")
 
 ENV_PREFIX = "CHUNKY_BITS_TPU_"
 

@@ -190,6 +190,12 @@ class HealthScoreboard:
         self._hedge_tokens = hedge_burst
         self._samples: deque[float] = deque(maxlen=self.SAMPLE_WINDOW)
         self._p95: Optional[float] = None  # memoized; None = recompute
+        #: optional QoS hedge gate (cluster/qos.py allow_hedge):
+        #: consulted before any token is consumed, so a suppressed
+        #: launch never burns budget.  None = no gate (pre-QoS
+        #: behavior).  The callable must be thread-safe to READ (the
+        #: scheduler's is: counter reads + a ring scan).
+        self._hedge_gate: Optional[Callable[[], bool]] = None
         self.hedges_fired = 0
         self.hedges_won = 0
         self.hedges_cancelled = 0
@@ -355,9 +361,30 @@ class HealthScoreboard:
                 self._hedge_tokens + self._hedge_ratio,
                 self._hedge_burst)
 
+    def set_hedge_gate(
+            self, fn: Optional[Callable[[], bool]]) -> None:
+        """Install (or clear) the QoS hedge gate: a callable the
+        scheduler owns that returns False when speculative load should
+        yield (admission pressure, or ample p99 headroom worth
+        conserving budget for).  Gate-denied launches consume NO
+        token — suppression must never tax the budget it protects."""
+        with self._lock:
+            self._hedge_gate = fn
+
+    def hedge_allowed(self) -> bool:
+        """Cheap gate pre-check (no token movement): lets the read
+        path skip arming a hedge timeout it would be denied anyway
+        (file/file_part.py).  True when no gate is installed."""
+        gate = self._hedge_gate
+        return gate is None or gate()
+
     def try_fire_hedge(self) -> bool:
-        """Consume one hedge token if available.  False = budget
-        exhausted, the caller keeps waiting on its primary."""
+        """Consume one hedge token if available AND the QoS gate (when
+        installed) allows.  False = budget exhausted or suppressed,
+        the caller keeps waiting on its primary."""
+        gate = self._hedge_gate
+        if gate is not None and not gate():
+            return False
         with self._lock:
             if self._hedge_tokens < 1.0:
                 return False

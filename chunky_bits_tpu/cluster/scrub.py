@@ -57,7 +57,7 @@ import json
 import logging
 import threading
 from dataclasses import dataclass
-from typing import Optional
+from typing import Callable, Optional
 
 from chunky_bits_tpu.cluster import clock as _clock
 from chunky_bits_tpu.errors import ChunkyBitsError, LocationError
@@ -84,21 +84,51 @@ class TokenBucket:
     (one chunk larger than the burst) drive the balance negative so the
     *average* still honors the rate.  A rate of 0 disables the bound
     (take returns immediately) — the daemon itself is not constructed
-    at rate 0, but --once CLI runs may scrub unthrottled."""
+    at rate 0, but --once CLI runs may scrub unthrottled.
+
+    An optional pressure hook (:meth:`set_pressure` — the QoS plane's
+    priority ordering, cluster/qos.py) scales *accrual* by
+    ``1 - pressure`` with a :data:`MIN_ACCRUAL` floor: under full
+    client-admission pressure scrub/repair I/O degrades to 5% of its
+    budget but NEVER stops accruing — a stuck pressure signal slows
+    the scrub walk, it cannot hang it (degrade, never hang)."""
 
     #: bound on a single sleep slice so cancellation (daemon stop)
     #: is always prompt
     MAX_SLEEP = 0.5
 
+    #: accrual floor under full pressure — background I/O yields to
+    #: client traffic but keeps a liveness trickle
+    MIN_ACCRUAL = 0.05
+
     def __init__(self, rate: float) -> None:
         self.rate = max(float(rate), 0.0)
         self._balance = self.rate  # start with one second of burst
         self._last = _clock.monotonic()
+        self._pressure: Optional[Callable[[], float]] = None
+
+    def set_pressure(self, fn: Optional[Callable[[], float]]) -> None:
+        """Install (or clear) the gateway pressure signal in [0, 1];
+        accrual scales by ``max(1 - pressure, MIN_ACCRUAL)``."""
+        self._pressure = fn
+
+    def _effective_rate(self) -> float:
+        """Accrual rate after the pressure throttle — the ONE number
+        both accrual and the wait estimate must use: waiting at the
+        unthrottled rate while accruing at the throttled one recovers
+        only ``1 - pressure`` of each wait, an asymptotic (Zeno) loop
+        that never reaches zero."""
+        rate = self.rate
+        if self._pressure is not None:
+            p = min(max(float(self._pressure()), 0.0), 1.0)
+            rate *= max(1.0 - p, self.MIN_ACCRUAL)
+        return rate
 
     def _accrue(self) -> None:
         now = _clock.monotonic()
         self._balance = min(
-            self._balance + (now - self._last) * self.rate, self.rate)
+            self._balance + (now - self._last) * self._effective_rate(),
+            self.rate)
         self._last = now
 
     async def take(self, nbytes: int) -> None:
@@ -107,8 +137,12 @@ class TokenBucket:
         self._accrue()
         self._balance -= nbytes
         while self._balance < 0:
-            wait = min(-self._balance / self.rate, self.MAX_SLEEP)
-            await _clock.sleep(wait)
+            wait = min(-self._balance / self._effective_rate(),
+                       self.MAX_SLEEP)
+            # floor the slice: float rounding (or pressure rising
+            # between estimate and accrual) must never shrink waits
+            # toward zero without the balance reaching it
+            await _clock.sleep(max(wait, 0.001))
             self._accrue()
 
 
@@ -689,6 +723,14 @@ class ScrubDaemon:
         return self.stats()
 
     # ---- daemon lifetime ----
+
+    def set_pressure(self,
+                     fn: Optional[Callable[[], float]]) -> None:
+        """Forward the gateway QoS pressure signal to the daemon's
+        token bucket — the ONE bucket every scrub and planner-repair
+        byte charges, so one hook throttles both (priority ordering:
+        client traffic > scrub/repair, cluster/qos.py)."""
+        self._bucket.set_pressure(fn)
 
     async def _run_forever(self) -> None:
         while True:

@@ -178,6 +178,17 @@ METADATA_KIND_ENV = "CHUNKY_BITS_TPU_METADATA_KIND"
 #: Read at gateway app build.
 SLO_EVAL_S_ENV = "CHUNKY_BITS_TPU_SLO_EVAL_S"
 
+#: multi-tenant QoS admission (cluster/qos.py + gateway/qos.py): on,
+#: the gateway fronts GET body streaming and PUT ingest with a
+#: deficit-round-robin scheduler over the closed tenant table (the
+#: YAML ``qos:`` mapping), throttles the scrub/repair buckets and
+#: suppresses hedge launches under admission pressure, and spends the
+#: hedge budget by read-p99 headroom.  Off by default (zero overhead —
+#: no scheduler object at all; bench --config 19 is the A/B).  YAML
+#: ``qos.enabled`` wins when present; this env flag decides when the
+#: YAML leaves it unset.  Read at gateway app build.
+QOS_ENV = "CHUNKY_BITS_TPU_QOS"
+
 #: opt-in runtime concurrency sanitizer (analysis/sanitizer.py):
 #: event-loop stall watchdog, task-leak registry, host-pipeline handoff
 #: checks.  Off by default (and force-disabled by bench.py — the
@@ -414,6 +425,15 @@ def slo_eval_s(*, default: float = 0.0) -> float:
     return v if v > 0 else default
 
 
+def qos_enabled(*, default: bool = False) -> bool:
+    """True when ``$CHUNKY_BITS_TPU_QOS`` asks for multi-tenant QoS
+    admission (cluster/qos.py).  YAML ``qos.enabled`` wins when the
+    mapping sets it; this flag decides when it is absent — the same
+    YAML-wins/env-default split every serving knob follows.  Read at
+    gateway app build (gateway/qos.maybe_build)."""
+    return env_flag(QOS_ENV, default=default)
+
+
 def read_retries(*, default: int = 1) -> int:
     """Env-supplied default for the ``read_retries`` tunable (YAML
     wins): per-location transient-HTTP retry count on the read
@@ -524,6 +544,10 @@ class Tunables:
     #: loudly against obs/slo.py SloObjectives' field set); empty =
     #: the conservative defaults
     slo: dict = field(default_factory=dict)
+    #: multi-tenant QoS config (the YAML ``qos:`` mapping, validated
+    #: loudly against cluster/qos.py QosConfig's key set); empty =
+    #: no named tenants, scheduler on only via the env flag
+    qos: dict = field(default_factory=dict)
 
     def is_device_backend(self) -> bool:
         """True when the erasure plane runs on an accelerator ("jax", a
@@ -641,6 +665,18 @@ class Tunables:
             except ValueError as err:
                 raise SerdeError(f"invalid slo mapping: {err}") from err
             slo_v = dict(slo_v)
+        qos_v = obj.get("qos", None)
+        if qos_v is not None:
+            # same loud-at-load contract as ``slo:`` — a typo'd tenant
+            # table must fail the cluster parse, not silently admit
+            # everyone as ``other``; cluster/qos.py owns the key set
+            from chunky_bits_tpu.cluster.qos import QosConfig
+
+            try:
+                QosConfig.from_obj(qos_v)
+            except ValueError as err:
+                raise SerdeError(f"invalid qos mapping: {err}") from err
+            qos_v = dict(qos_v)
         return cls(
             https_only=bool(obj.get("https_only", False)),
             on_conflict=on_conflict,
@@ -663,6 +699,7 @@ class Tunables:
             **({"slo_eval_s": slo_eval_v}
                if slo_eval_v is not None else {}),
             **({"slo": slo_v} if slo_v is not None else {}),
+            **({"qos": qos_v} if qos_v is not None else {}),
         )
 
     def to_obj(self) -> dict:
@@ -691,6 +728,8 @@ class Tunables:
             obj["slo_eval_s"] = self.slo_eval_s
         if self.slo:
             obj["slo"] = dict(self.slo)
+        if self.qos:
+            obj["qos"] = dict(self.qos)
         return obj
 
     def location_context(self) -> LocationContext:

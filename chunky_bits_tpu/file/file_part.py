@@ -470,8 +470,14 @@ class FilePart:
             try:
                 hedge_more = True
                 while pending:
+                    # the QoS gate pre-check (hedge_allowed) keeps a
+                    # suppressed fetch from waking every hedge_delay
+                    # just to be denied a token — under gateway
+                    # admission pressure the race degrades to the
+                    # serial walk's own network timeouts
                     timeout = (health.hedge_delay()
                                if hedge_more and next_i < len(locs)
+                               and health.hedge_allowed()
                                else None)
                     # lint: unbounded-await-ok bounded by construction:
                     # either the hedge delay, or the racers' own
@@ -588,7 +594,8 @@ class FilePart:
                     # replica slow too, or none left — does the pool
                     # draw an extra chunk for reconstruction
                     timeout = (2.0 * health.hedge_delay()
-                               if hedge_more and pool else None)
+                               if hedge_more and pool
+                               and health.hedge_allowed() else None)
                     # lint: unbounded-await-ok bounded by construction:
                     # the hedge delay, or the workers' own per-location
                     # network timeouts once the pool/budget is dry

@@ -79,6 +79,10 @@ class RequestLog:
     nbytes: int
     duration: float  # seconds of wall time
     source: str
+    #: resolved QoS tenant (cluster/qos.py closed table) or "-" when
+    #: the scheduler is off — lets one access log answer per-tenant
+    #: p99 questions (tenant_request_stats) without a second log
+    tenant: str = "-"
 
 
 @dataclass
@@ -125,6 +129,19 @@ def request_stats(entries: list) -> RequestStats:
     )
 
 
+def tenant_request_stats(entries: list) -> dict:
+    """Per-tenant :class:`RequestStats` split of the access log —
+    the serving-plane isolation question ("whose p99 moved?") answered
+    from the SAME records and the SAME :func:`percentile` code as the
+    aggregate.  Key count is bounded by the closed tenant table
+    (cluster/qos.py) plus "-" for scheduler-off records."""
+    by_tenant: dict = {}
+    for e in entries:
+        by_tenant.setdefault(getattr(e, "tenant", "-"), []).append(e)
+    return {tenant: request_stats(rows)
+            for tenant, rows in sorted(by_tenant.items())}
+
+
 @dataclass
 class ResultLog:
     kind: str  # "read" | "write"
@@ -165,6 +182,7 @@ class Profiler:
         self._healths: list = []  # location-health scoreboards ditto
         self._scrubs: list = []  # scrub daemons ditto
         self._slos: list = []  # SLO engines (obs/slo.py) ditto
+        self._qos: list = []  # QoS schedulers (cluster/qos.py) ditto
         # per-location failure notes from the read fall-through
         # (fetch_chunk): which location failed / was corrupt and why —
         # the diagnosable trail the anonymous `except LocationError:
@@ -267,6 +285,20 @@ class Profiler:
         with self._lock:
             return [e.stats() for e in self._slos]
 
+    def attach_qos(self, scheduler) -> None:
+        """Register a QoS scheduler (cluster/qos.py) so per-tenant
+        admission/shed/queue counters ride along in the report's
+        ``Qos<...>`` stanza — the same snapshot ``/stats`` and the
+        ``cb_qos_*`` families read (one set of numbers)."""
+        with self._lock:
+            if all(q is not scheduler for q in self._qos):
+                self._qos.append(scheduler)
+
+    def qos_stats(self) -> list:
+        """Snapshot of each attached QoS scheduler (QosStats)."""
+        with self._lock:
+            return [q.stats() for q in self._qos]
+
     def log_location_failure(self, location, error: str) -> None:
         """A per-location read failure (unreadable or hash-mismatched)
         recorded by the chunk fall-through — the read completed via
@@ -286,14 +318,18 @@ class Profiler:
         return out
 
     def log_request(self, method: str, path: str, status: int,
-                    nbytes: int, duration: float, source: str) -> None:
+                    nbytes: int, duration: float, source: str,
+                    tenant: str = "-") -> None:
         """One gateway request completed (gateway/http.py's access-log
         middleware): the same counters production logs print feed the
         report's :class:`RequestStats`, so serving percentiles come
         from one code path whether read off a log line or a bench
-        run."""
+        run.  ``tenant`` is the resolved QoS tenant ("-" = scheduler
+        off) — it stays OUT of the registry's request families (the
+        per-tenant series are the scheduler's own ``cb_qos_*``) and
+        IN the access log for :func:`tenant_request_stats`."""
         entry = RequestLog(method, path, status, nbytes, duration,
-                           source)
+                           source, tenant)
         with self._lock:
             dropped = self._append(self._requests, "requests", entry)
         if dropped:
@@ -354,7 +390,8 @@ class ProfileReport:
                  pipeline_stats: list = (), health_stats: list = (),
                  location_failures: list = (), requests: list = (),
                  scrub_stats: list = (), slo_stats: list = (),
-                 dropped: Optional[dict] = None):
+                 dropped: Optional[dict] = None,
+                 qos_stats: list = ()):
         self.entries = entries
         self.cache_stats = list(cache_stats)
         self.pipeline_stats = list(pipeline_stats)
@@ -363,6 +400,7 @@ class ProfileReport:
         self.requests = list(requests)
         self.scrub_stats = list(scrub_stats)
         self.slo_stats = list(slo_stats)
+        self.qos_stats = list(qos_stats)
         self.dropped = dict(dropped or {})
 
     def _avg(self, kind: str) -> Optional[float]:
@@ -404,6 +442,8 @@ class ProfileReport:
             base += f" {stats}"
         for stats in self.slo_stats:
             base += f" {stats}"
+        for stats in self.qos_stats:
+            base += f" {stats}"
         if self.requests:
             base += f" {request_stats(self.requests)}"
         if self.location_failures:
@@ -435,7 +475,8 @@ class ProfileReporter:
                              self._profiler.drain_requests(),
                              self._profiler.scrub_stats(),
                              self._profiler.slo_stats(),
-                             self._profiler.drop_counts())
+                             self._profiler.drop_counts(),
+                             self._profiler.qos_stats())
 
 
 def new_profiler() -> tuple[Profiler, ProfileReporter]:

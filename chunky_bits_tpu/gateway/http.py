@@ -55,6 +55,7 @@ import json
 import logging
 import os
 import time
+from collections import deque
 from typing import Callable, Optional
 
 from aiohttp import web
@@ -62,7 +63,8 @@ from aiohttp import web
 from chunky_bits_tpu.cluster import Cluster
 from chunky_bits_tpu.errors import ChunkyBitsError, MetadataReadError
 from chunky_bits_tpu.file.file_reference import FileReference
-from chunky_bits_tpu.file.profiler import Profiler, request_stats
+from chunky_bits_tpu.file.profiler import (Profiler, request_stats,
+                                           tenant_request_stats)
 from chunky_bits_tpu.obs import metrics as obs_metrics
 from chunky_bits_tpu.obs import tracing as obs_tracing
 from chunky_bits_tpu.utils import aio
@@ -89,9 +91,19 @@ _RATE_GRACE_SECONDS = 30.0
 #: shedding beats buffering.  <=0 = unbounded.
 DEFAULT_MAX_CONCURRENT_GETS = 256
 
-#: Retry-After seconds on a shed GET — short: a slot frees as soon as
-#: any in-flight body finishes
+#: Retry-After fallback on a shed GET when no completion-rate signal
+#: exists yet (cold worker) — short: a slot frees as soon as any
+#: in-flight body finishes.  With traffic observed, the header is
+#: DERIVED per shed: expected wait ≈ waiting requests over the recent
+#: GET completion rate (see ``_retry_after`` in make_app), clamped to
+#: [1, _RETRY_AFTER_MAX] so clients back off proportionally to the
+#: actual queue instead of hammering a saturated worker every second.
 _RETRY_AFTER_SECONDS = "1"
+
+#: Retry-After derivation bounds: completion timestamps remembered
+#: (rate window) and the clamp ceiling in seconds
+_RETRY_AFTER_WINDOW = 64
+_RETRY_AFTER_MAX = 30
 
 #: bound on the (path, size, mtime_ns) -> verified-digest memo feeding
 #: the sendfile fast path; oldest entries drop past this (FIFO — a
@@ -380,6 +392,51 @@ def make_app(cluster: Cluster,
     if slo_engine is not None:
         profiler.attach_slo(slo_engine)
 
+    # Multi-tenant QoS scheduler (gateway/qos.py + cluster/qos.py):
+    # weighted-fair admission in front of GET bodies and PUT ingest,
+    # default OFF — same zero-idle-cost discipline as the SLO engine:
+    # the enablement check below reads only the YAML dict / env flag,
+    # so the qos modules are never even imported when off.  When on,
+    # the scheduler also becomes the pressure/hedge authority: scrub
+    # and planner-repair I/O throttle against gateway read pressure
+    # (priority: client reads > writes > hedges > scrub/repair), and
+    # the scoreboard's hedge launches route through its SLO-aware
+    # advisor (suppress under pressure, conserve when read p99 has
+    # ample headroom).
+    _qos_cfg = cluster.tunables.qos or {}
+    _qos_on = _qos_cfg.get("enabled")
+    if _qos_on is None:
+        from chunky_bits_tpu.cluster.tunables import qos_enabled
+
+        _qos_on = qos_enabled()
+    qos_sched = None
+    # the shed exception type the admission sites catch; an empty
+    # tuple (qos off) catches nothing, so the off path has no qos
+    # reference at all beyond the None check
+    qos_shed_exc: tuple = ()
+    if _qos_on:
+        from chunky_bits_tpu.cluster.qos import QosShedError
+        from chunky_bits_tpu.gateway import qos as gw_qos
+
+        qos_shed_exc = (QosShedError,)
+        qos_sched = gw_qos.maybe_build(
+            cluster,
+            read_capacity=(max_concurrent_gets
+                           if max_concurrent_gets > 0
+                           else DEFAULT_MAX_CONCURRENT_GETS),
+            write_capacity=(max_concurrent_puts
+                            if max_concurrent_puts > 0
+                            else DEFAULT_MAX_CONCURRENT_PUTS))
+    if qos_sched is not None:
+        profiler.attach_qos(qos_sched)
+        # hedges yield to client traffic: the scoreboard consults the
+        # scheduler before arming/firing (suppression burns no budget)
+        cluster.health_scoreboard().set_hedge_gate(qos_sched.allow_hedge)
+        if scrub is not None:
+            # scrub/repair I/O rides the same token bucket; pressure
+            # scales its accrual down (floor 5% — degrade, never hang)
+            scrub.set_pressure(qos_sched.pressure)
+
     # build/configuration identity for the fleet view: one static
     # gauge whose labels say which version/backend/flags THIS worker
     # runs — merged /metrics labels it per worker, so a mixed-version
@@ -396,6 +453,7 @@ def make_app(cluster: Cluster,
             "sendfile": "on" if sendfile else "off",
             "scrub": "on" if scrub is not None else "off",
             "slo": "on" if slo_engine is not None else "off",
+            "qos": "on" if qos_sched is not None else "off",
         }, registry)
 
     # PUT ingest compute (per-shard SHA-256 + per-stripe GF encode) runs
@@ -419,6 +477,30 @@ def make_app(cluster: Cluster,
     # in-flight GET bodies (admission control); a plain counter — all
     # bookkeeping happens on the app's loop
     gets_in_flight = {"now": 0}
+
+    # GET-body completion timestamps, bounded ring — the observed
+    # service rate the derived Retry-After reads.  Loop-local like
+    # gets_in_flight (appended only from handle_get's finally).
+    get_done: deque = deque(maxlen=_RETRY_AFTER_WINDOW)
+
+    def _retry_after() -> str:
+        """Retry-After for a shed request, derived from load: expected
+        wait for a slot ≈ (requests ahead + 1) / observed GET-body
+        completion rate over the recent window, clamped to
+        [1, _RETRY_AFTER_MAX] seconds.  A cold worker (no completions
+        yet, or a stalled window) answers the 1-second fallback — the
+        old hardcoded behavior — rather than guessing."""
+        if len(get_done) < 2:
+            return _RETRY_AFTER_SECONDS
+        span = time.monotonic() - get_done[0]
+        if span <= 0:
+            return _RETRY_AFTER_SECONDS
+        rate = len(get_done) / span      # completions per second
+        ahead = gets_in_flight["now"]
+        if qos_sched is not None:
+            ahead += qos_sched.queued("read")
+        wait = (ahead + 1) / rate
+        return str(max(1, min(int(wait + 0.5), _RETRY_AFTER_MAX)))
 
     # extent key -> validity token of chunk extents whose digest
     # verified, FIFO-bounded; keyed state is per-app (= per worker
@@ -646,22 +728,40 @@ def make_app(cluster: Cluster,
         # always answered even at the bound.  Shed, don't queue: an
         # immediate 503 with Retry-After keeps worker memory bounded
         # under a client storm and tells well-behaved clients exactly
-        # what to do.
-        if (max_concurrent_gets > 0
+        # what to do.  With QoS on, admission runs through the
+        # weighted-fair scheduler instead: requests queue briefly
+        # (bounded depth + wait) per tenant so one flooding tenant
+        # cannot starve the others; overflow still sheds 503.
+        if qos_sched is not None:
+            try:
+                # lint: lock-discipline-ok a failed acquire grants no
+                # slot (shed/cancel paths hold nothing to release);
+                # granted slots release in the try/finally just below
+                await qos_sched.acquire("read", request["cb_tenant"],
+                                        cost=length)
+            except qos_shed_exc:
+                shed_counter.inc()
+                return web.Response(
+                    status=503, text="error: too many in-flight reads\n",
+                    headers={"Retry-After": _retry_after()})
+        elif (max_concurrent_gets > 0
                 and gets_in_flight["now"] >= max_concurrent_gets):
             shed_counter.inc()
             return web.Response(
                 status=503, text="error: too many in-flight reads\n",
-                headers={"Retry-After": _RETRY_AFTER_SECONDS})
-        gets_in_flight["now"] += 1
-        inflight_gauge.set(gets_in_flight["now"])
+                headers={"Retry-After": _retry_after()})
         try:
+            gets_in_flight["now"] += 1
+            inflight_gauge.set(gets_in_flight["now"])
             return await _serve_get_body(request, path, file_ref,
                                          builder, status, headers,
                                          length)
         finally:
             gets_in_flight["now"] -= 1
             inflight_gauge.set(gets_in_flight["now"])
+            get_done.append(time.monotonic())
+            if qos_sched is not None:
+                qos_sched.release("read")
 
     async def _serve_get_body(request: web.Request, path: str,
                               file_ref: FileReference, builder,
@@ -735,35 +835,59 @@ def make_app(cluster: Cluster,
         profile = cluster.get_profile(None)
         content_type: Optional[str] = request.headers.get("Content-Type")
 
+        declared = request.headers.get("Content-Length")
         if max_put_bytes is not None:
-            declared = request.headers.get("Content-Length")
             if declared is not None and int(declared) > max_put_bytes:
                 put_reject_counter.labels(reason="too_large").inc()
                 return put_reject(413, "error: body too large\n")
 
-        # A rejected/aborted ingest can leave orphaned shards; they are
-        # content-addressed (possibly shared with other files), so they
-        # are left for the reference-checking find-unused-hashes GC
-        # rather than deleted blindly.
-        async with put_sem:
+        # With QoS on, write admission runs through the weighted-fair
+        # scheduler BEFORE the body is read: grants stay <= the write
+        # capacity (= the put_sem bound), so put_sem below never
+        # actually waits — it stays as the invariant backstop.  Write
+        # grants are deferred while client reads queue (priority:
+        # reads > writes), bounded by the scheduler's wait deadline.
+        if qos_sched is not None:
+            cost = int(declared) if declared is not None else None
             try:
-                await cluster.write_file(
-                    path,
-                    _GuardedBody(request.content, max_put_bytes,
-                                 min_put_rate),
-                    profile, content_type)
-            except _BodyTooLarge:
-                put_reject_counter.labels(reason="too_large").inc()
-                return put_reject(413, "error: body too large\n")
-            except _BodyTooSlow:
-                put_reject_counter.labels(reason="too_slow").inc()
-                return put_reject(408, "error: ingest too slow\n")
-            except ChunkyBitsError as err:
-                log.error("PUT %s failed: %s", path, err)
-                log.error("location health at failure: %s",
-                          health.stats())
-                put_reject_counter.labels(reason="error").inc()
-                return put_reject(500, "error: internal error\n")
+                # lint: lock-discipline-ok a failed acquire grants no
+                # slot (shed/cancel paths hold nothing to release);
+                # granted slots release in the try/finally just below
+                await qos_sched.acquire("write", request["cb_tenant"],
+                                        cost=cost)
+            except qos_shed_exc:
+                put_reject_counter.labels(reason="shed").inc()
+                resp = put_reject(
+                    503, "error: too many in-flight writes\n")
+                resp.headers["Retry-After"] = _retry_after()
+                return resp
+        try:
+            # A rejected/aborted ingest can leave orphaned shards; they
+            # are content-addressed (possibly shared with other files),
+            # so they are left for the reference-checking
+            # find-unused-hashes GC rather than deleted blindly.
+            async with put_sem:
+                try:
+                    await cluster.write_file(
+                        path,
+                        _GuardedBody(request.content, max_put_bytes,
+                                     min_put_rate),
+                        profile, content_type)
+                except _BodyTooLarge:
+                    put_reject_counter.labels(reason="too_large").inc()
+                    return put_reject(413, "error: body too large\n")
+                except _BodyTooSlow:
+                    put_reject_counter.labels(reason="too_slow").inc()
+                    return put_reject(408, "error: ingest too slow\n")
+                except ChunkyBitsError as err:
+                    log.error("PUT %s failed: %s", path, err)
+                    log.error("location health at failure: %s",
+                              health.stats())
+                    put_reject_counter.labels(reason="error").inc()
+                    return put_reject(500, "error: internal error\n")
+        finally:
+            if qos_sched is not None:
+                qos_sched.release("write")
         return web.Response(status=200)
 
     @web.middleware
@@ -785,6 +909,13 @@ def make_app(cluster: Cluster,
         start = time.monotonic()
         status = 500
         nbytes = 0
+        # tenant identity resolves HERE, once per request, before any
+        # handler runs — both admission sites and the log read it.
+        # Resolution is total over the CLOSED table (unmatched -> the
+        # "other" bucket), so the logged value can never mint a label.
+        if qos_sched is not None:
+            request["cb_tenant"] = gw_qos.resolve_request_tenant(
+                qos_sched.config, request)
         trace = token = None
         if trace_slow_s > 0:
             trace_id = obs_tracing.clean_id(
@@ -805,8 +936,16 @@ def make_app(cluster: Cluster,
         finally:
             duration = time.monotonic() - start
             source = request.get("cb_source", "-")
+            tenant = request.get("cb_tenant", "-")
             profiler.log_request(request.method, request.path, status,
-                                 nbytes, duration, source)
+                                 nbytes, duration, source, tenant)
+            if qos_sched is not None and status < 500:
+                # completion-latency sample for the SLO-aware hedge
+                # advisor — same numbers the access log just recorded
+                if request.method == "GET":
+                    qos_sched.note_request("read", duration)
+                elif request.method == "PUT":
+                    qos_sched.note_request("write", duration)
             if trace is not None and token is not None:
                 trace.add("request", "gateway", start, duration,
                           str(status))
@@ -818,8 +957,8 @@ def make_app(cluster: Cluster,
                           "source": source, "worker": worker_id})
             log.info(
                 "req method=%s path=%s status=%d bytes=%d ms=%.2f "
-                "source=%s", request.method, request.path, status,
-                nbytes, duration * 1000.0, source)
+                "source=%s tenant=%s", request.method, request.path,
+                status, nbytes, duration * 1000.0, source, tenant)
 
     async def handle_scrub_status(request: web.Request) -> web.Response:
         """Scrub observability: counters + running state as JSON.
@@ -883,7 +1022,7 @@ def make_app(cluster: Cluster,
         summary computed by the same ``request_stats``/``percentile``
         code bench --config 9 uses."""
         request["cb_source"] = "meta"
-        return web.json_response({
+        payload = {
             "worker": worker_id,
             "requests": request_stats(
                 profiler.peek_requests()).to_obj(),
@@ -892,8 +1031,18 @@ def make_app(cluster: Cluster,
                      **slo_engine.stats().to_obj()}
                     if slo_engine is not None
                     else {"enabled": False}),
+            "qos": (qos_sched.stats().to_obj()
+                    if qos_sched is not None
+                    else {"enabled": False}),
             "metrics": registry.snapshot(),
-        })
+        }
+        if qos_sched is not None:
+            # per-tenant access-log percentiles, same request_stats
+            # code as the aggregate block above
+            payload["requests_by_tenant"] = {
+                t: s.to_obj() for t, s in tenant_request_stats(
+                    profiler.peek_requests()).items()}
+        return web.json_response(payload)
 
     async def handle_healthz(request: web.Request) -> web.Response:
         """Per-worker liveness/readiness: 200 while serving, 503 once

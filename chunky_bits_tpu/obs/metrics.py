@@ -256,7 +256,8 @@ class MetricsRegistry:
 
     def register_source(self, kind: str, obj: object) -> None:
         """Weakly register a stat source (``kind`` one of "cache",
-        "pipeline", "health", "scrub", "repair", "xor_schedule"); its
+        "pipeline", "health", "scrub", "repair", "xor_schedule",
+        "qos"); its
         ``stats()`` (``info()`` for the xor-schedule cache) snapshot is
         folded into every registry snapshot while the object lives.
         Registration never extends the object's lifetime, so per-loop
@@ -607,6 +608,47 @@ def _source_families(reg: MetricsRegistry) -> list[dict]:
                          "scrub byte-rate bound", [_scalar(
                              sum(x["rate_bytes_per_sec"]
                                  for x in scrubs))]))
+
+    qoses = [q.stats().to_obj() for q in reg._live_sources("qos")]
+    if qoses:
+        # the ``tenant`` label values come from the scheduler's CLOSED
+        # table (named YAML tenants + "other", cluster/qos.py) — the
+        # only place tenant names exist, so nothing here can mint one
+        # (CB107); per-worker schedulers sum in the fleet merge like
+        # every counter family
+        tenants: dict[str, dict] = {}
+        for q in qoses:
+            for name, row in q["tenants"].items():
+                agg = tenants.setdefault(
+                    name, {"admitted": 0.0, "shed": 0.0, "bytes": 0.0,
+                           "throttle_waits": 0.0, "queued": 0.0})
+                for key in agg:
+                    agg[key] += float(row.get(key, 0) or 0)
+        for metric, key, kind, help_ in (
+                ("cb_qos_admitted_total", "admitted", COUNTER,
+                 "QoS admissions granted"),
+                ("cb_qos_shed_total", "shed", COUNTER,
+                 "QoS admissions shed (queue full / wait deadline)"),
+                ("cb_qos_bytes_total", "bytes", COUNTER,
+                 "QoS bytes admitted"),
+                ("cb_qos_throttle_waits_total", "throttle_waits",
+                 COUNTER, "QoS per-tenant rate-bucket waits"),
+                ("cb_qos_queued", "queued", GAUGE,
+                 "QoS waiters currently queued")):
+            fams.append(_fam(metric, kind, help_, [
+                _scalar(agg[key], tenant=tenant)
+                for tenant, agg in sorted(tenants.items())]))
+        qsum = _sum_rows(qoses, ("hedge_suppressed",
+                                 "hedge_conserved"))
+        fams.append(_fam("cb_qos_hedge_suppressed_total", COUNTER,
+                         "hedge launches suppressed under admission "
+                         "pressure", [_scalar(qsum["hedge_suppressed"])]))
+        fams.append(_fam("cb_qos_hedge_conserved_total", COUNTER,
+                         "hedge budget conserved on ample p99 headroom",
+                         [_scalar(qsum["hedge_conserved"])]))
+        fams.append(_fam("cb_qos_pressure", GAUGE,
+                         "gateway admission pressure [0,1]",
+                         [_scalar(max(q["pressure"] for q in qoses))]))
 
     scheds = [s.info() for s in reg._live_sources("xor_schedule")]
     if scheds:

@@ -551,6 +551,57 @@ def test_config18_failure_emits_one_json_line():
     assert "error" in rec
 
 
+def test_config19_smoke_emits_one_json_line():
+    """--config 19 --smoke (multi-tenant QoS noisy-neighbor A/B:
+    antagonist flood vs victim, isolation off vs on through one
+    in-process gateway) honors the driver contract: exactly one
+    parseable JSON line on stdout with the required keys, exit 0 —
+    and the run itself asserts per-tenant byte identity in both legs
+    (every victim body, sampled antagonist bodies)."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "19", "--smoke"],
+        cwd=REPO, env=env, capture_output=True, timeout=300)
+    assert r.returncode == 0, r.stderr.decode()[-800:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline",
+                "antagonists", "victim_reads", "max_concurrent_gets",
+                "off", "on", "aggregate_rps_ratio"):
+        assert key in rec
+    assert rec["unit"] == "x"
+    # smoke scale pins the contract + per-tenant identity + the
+    # direction of the win, not the 5x acceptance ratio — that is
+    # BASELINE.md's full-scale row
+    assert rec["value"] > 1.0
+    for leg in ("off", "on"):
+        assert rec[leg]["victim_p99_ms"] > 0
+        assert rec[leg]["ok"] > 0
+    # the OFF leg must actually shed (else the flood was no flood);
+    # the ON leg queues fairly instead of shedding the victim
+    assert rec["off"]["shed_503"] > 0
+
+
+def test_config19_failure_emits_one_json_line():
+    """ANY --config 19 failure (here: a non-positive flood size)
+    still produces exactly one parseable JSON line and exit 3 — the
+    same contract as configs 8-18 and the device runs."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--config", "19",
+         "--antagonists", "0"],
+        cwd=REPO, env=env, capture_output=True, timeout=120)
+    assert r.returncode == 3, r.stderr.decode()[-500:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec
+    assert rec["value"] == 0.0
+    assert "error" in rec
+
+
 def test_seams_only_shrink_and_tolerate_garbage():
     """Inherited env values must not break the contract: malformed or
     larger-than-default values fall back to the real budget."""

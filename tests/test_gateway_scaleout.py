@@ -22,7 +22,7 @@ from chunky_bits_tpu.gateway.workers import GatewaySupervisor
 
 
 def make_cluster(tmp_path, backend=None, cache_bytes=0,
-                 chunk_size=16) -> Cluster:
+                 chunk_size=16, qos=None) -> Cluster:
     dirs = []
     for i in range(5):
         d = tmp_path / f"disk{i}"
@@ -35,6 +35,8 @@ def make_cluster(tmp_path, backend=None, cache_bytes=0,
         tunables["backend"] = backend
     if cache_bytes:
         tunables["cache_bytes"] = cache_bytes
+    if qos is not None:
+        tunables["qos"] = qos
     return Cluster.from_obj({
         "destinations": [{"location": d} for d in dirs],
         "metadata": {"type": "path", "format": "yaml", "path": str(meta)},
@@ -236,7 +238,10 @@ def test_admission_control_sheds_excess_gets(tmp_path, monkeypatch):
     async def main():
         from aiohttp.test_utils import TestClient, TestServer
 
-        cluster = make_cluster(tmp_path)
+        # pin QoS OFF in YAML (wins over the env flag): this test
+        # covers the immediate-shed admission path, which QoS-on
+        # replaces with bounded per-tenant queueing
+        cluster = make_cluster(tmp_path, qos={"enabled": False})
         gate = asyncio.Event()
         real_stream = FileReadBuilder.stream
 

@@ -1,0 +1,612 @@
+"""Multi-tenant QoS tests (cluster/qos.py + gateway/qos.py + the
+gateway wiring).
+
+Four layers, matching the QoS plane's pieces:
+
+* **config + resolution** — the closed tenant table: loud YAML
+  validation, exact-key > longest-prefix > ``other`` resolution, the
+  10k-distinct-key hammer that proves the tenant label set can never
+  grow past the configured names + ``other`` (CB107 by construction);
+* **the scheduler** — DRR rotation (a weighted victim interleaves with
+  an antagonist backlog instead of queueing behind it), read>write
+  priority gating, per-tenant rate buckets (virtual-time), queue-full
+  and wait-deadline shedding, pressure, and the SLO-aware hedge
+  advisor;
+* **downstream hooks** — the scoreboard hedge gate (denied launches
+  consume NO budget token) and the scrub bucket's pressure-scaled
+  accrual with its degrade-never-hang floor;
+* **the gateway** — tenant resolution into the access log and
+  ``request_stats`` split, per-tenant ``cb_qos_*`` families on
+  /metrics, the /stats qos stanza, the derived Retry-After, and the
+  zero-overhead-off default (no qos modules imported, no qos label
+  sets minted).
+"""
+
+import asyncio
+import os
+import sys
+
+import pytest
+
+from chunky_bits_tpu.cluster import tunables as tunables_mod
+from chunky_bits_tpu.cluster.health import HealthScoreboard
+from chunky_bits_tpu.cluster.qos import (
+    MAX_TENANTS,
+    OTHER,
+    QosConfig,
+    QosScheduler,
+    QosShedError,
+)
+from chunky_bits_tpu.cluster.scrub import TokenBucket
+from chunky_bits_tpu.errors import SerdeError
+from chunky_bits_tpu.obs import metrics as obs_metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- config + resolution ----
+
+def test_config_parses_and_round_trips():
+    obj = {
+        "enabled": True,
+        "tenants": {
+            "gold": {"weight": 4, "keys": ["k-gold"],
+                     "prefixes": ["/gold/"]},
+            "bulk": {"rate_bytes_per_sec": 1e6,
+                     "prefixes": ["/bulk/"]},
+        },
+        "other": {"weight": 2},
+    }
+    config = QosConfig.from_obj(obj)
+    assert config.enabled is True
+    assert config.other_weight == 2.0
+    assert config.tenant_names() == ("gold", "bulk", OTHER)
+    assert QosConfig.from_obj(config.to_obj()) == config
+
+
+def test_config_validation_is_loud():
+    with pytest.raises(ValueError, match="unknown keys"):
+        QosConfig.from_obj({"tenats": {}})
+    with pytest.raises(ValueError, match="unknown keys"):
+        QosConfig.from_obj({"tenants": {"a": {"wait": 1}}})
+    with pytest.raises(ValueError, match="weight"):
+        QosConfig.from_obj({"tenants": {"a": {"weight": 0}}})
+    with pytest.raises(ValueError, match="rate_bytes_per_sec"):
+        QosConfig.from_obj(
+            {"tenants": {"a": {"rate_bytes_per_sec": -1}}})
+    with pytest.raises(ValueError, match="reserved"):
+        QosConfig.from_obj({"tenants": {OTHER: {}}})
+    with pytest.raises(ValueError, match="claimed by both"):
+        QosConfig.from_obj({"tenants": {"a": {"keys": ["k"]},
+                                        "b": {"keys": ["k"]}}})
+    with pytest.raises(ValueError, match="MAX_TENANTS"):
+        QosConfig.from_obj({"tenants": {
+            f"t{i}": {} for i in range(MAX_TENANTS + 1)}})
+    with pytest.raises(ValueError, match="enabled"):
+        QosConfig.from_obj({"enabled": "yes"})
+
+
+def test_resolution_key_beats_prefix_longest_prefix_wins():
+    config = QosConfig.from_obj({"tenants": {
+        "a": {"keys": ["key-a"], "prefixes": ["/data/"]},
+        "b": {"prefixes": ["/data/hot/"]},
+    }})
+    # exact API key wins even when the path matches another tenant
+    assert config.resolve("key-a", "/data/hot/x") == "a"
+    # no key: longest matching prefix
+    assert config.resolve(None, "/data/hot/x") == "b"
+    assert config.resolve(None, "/data/cold/x") == "a"
+    # missing key + unmatched path -> other; unknown key ignored
+    assert config.resolve(None, "/elsewhere") == OTHER
+    assert config.resolve("key-unknown", "/elsewhere") == OTHER
+
+
+def test_distinct_key_hammer_never_mints_tenants():
+    """10k distinct API keys all land in ``other``: the tenant label
+    set stays CLOSED (the configured names + other), far under the
+    registry's MAX_LABEL_SETS ceiling."""
+    config = QosConfig.from_obj(
+        {"tenants": {"gold": {"keys": ["k-gold"]}}})
+    seen = {config.resolve(f"rotating-{i}", f"/spray/{i}")
+            for i in range(10_000)}
+    assert seen == {OTHER}
+
+    async def hammer():
+        sched = QosScheduler(config, read_capacity=4096,
+                             write_capacity=8)
+        for i in range(10_000):
+            tenant = config.resolve(f"rotating-{i}", "/x")
+            await sched.acquire("read", tenant, cost=10)
+            sched.release("read")
+        return sched.stats()
+
+    stats = asyncio.run(hammer())
+    rows = {r.tenant for r in stats.rows}
+    assert rows == {"gold", OTHER}
+    assert stats.to_obj()["tenants"][OTHER]["admitted"] == 10_000
+    assert len(rows) <= obs_metrics.MAX_LABEL_SETS
+
+
+# ---- the scheduler ----
+
+def test_drr_interleaves_tenants_instead_of_fifo():
+    """With an antagonist backlog queued first, a victim's waiters are
+    granted every other rotation — never behind the whole backlog."""
+
+    async def main():
+        config = QosConfig.from_obj({"tenants": {
+            "ant": {"keys": ["A"]}, "vic": {"keys": ["V"]}}})
+        sched = QosScheduler(config, read_capacity=2,
+                             write_capacity=1, queue_timeout_s=30)
+        await sched.acquire("read", "ant", cost=100)
+        await sched.acquire("read", "ant", cost=100)
+        grants: list = []
+
+        async def waiter(tenant, tag):
+            await sched.acquire("read", tenant, cost=100)
+            grants.append(tag)
+
+        tasks = [asyncio.ensure_future(waiter("ant", f"a{i}"))
+                 for i in range(4)]
+        await asyncio.sleep(0)
+        tasks += [asyncio.ensure_future(waiter("vic", f"v{i}"))
+                  for i in range(2)]
+        await asyncio.sleep(0)
+        assert sched.queued("read") == 6
+        assert sched.pressure() == 1.0
+        for _ in range(6):
+            sched.release("read")
+            await asyncio.sleep(0)
+        await asyncio.gather(*tasks)
+        # FIFO would be a0 a1 a2 a3 v0 v1; DRR rotates tenants
+        assert grants[:4] == ["a0", "v0", "a1", "v1"], grants
+
+    asyncio.run(main())
+
+
+def test_writes_gated_while_reads_queue():
+    """Priority classes: a write grant is deferred while read waiters
+    queue, and released the moment the read queue drains."""
+
+    async def main():
+        config = QosConfig.from_obj({})
+        sched = QosScheduler(config, read_capacity=1,
+                             write_capacity=4, queue_timeout_s=30)
+        await sched.acquire("read", OTHER)
+
+        read_granted = asyncio.Event()
+        write_granted = asyncio.Event()
+
+        async def reader():
+            await sched.acquire("read", OTHER)
+            read_granted.set()
+
+        async def writer():
+            await sched.acquire("write", OTHER)
+            write_granted.set()
+
+        r = asyncio.ensure_future(reader())
+        await asyncio.sleep(0)
+        w = asyncio.ensure_future(writer())
+        for _ in range(3):
+            await asyncio.sleep(0)
+        # write capacity is free, but reads are queued -> gated
+        assert not write_granted.is_set()
+        sched.release("read")
+        for _ in range(3):
+            await asyncio.sleep(0)
+        assert read_granted.is_set()
+        assert write_granted.is_set()
+        await asyncio.gather(r, w)
+
+    asyncio.run(main())
+
+
+def test_queue_full_and_deadline_shed():
+    async def main():
+        config = QosConfig.from_obj({})
+        sched = QosScheduler(config, read_capacity=1,
+                             write_capacity=1, max_queue=1,
+                             queue_timeout_s=0.05)
+        await sched.acquire("read", OTHER)
+        waiter = asyncio.ensure_future(sched.acquire("read", OTHER))
+        await asyncio.sleep(0)
+        # queue full: the next arrival sheds immediately
+        with pytest.raises(QosShedError, match="queue full"):
+            await sched.acquire("read", OTHER)
+        # the queued waiter sheds once the deadline passes (degrade,
+        # never hang) — the slot is never released
+        with pytest.raises(QosShedError, match="admission wait"):
+            await waiter
+        stats = sched.stats().to_obj()["tenants"][OTHER]
+        assert stats["shed"] == 2
+        assert stats["queue_peak"] == 1
+
+    asyncio.run(main())
+
+
+def test_idle_pipe_grants_oversized_waiter():
+    """Work-conserving escape: a waiter whose cost out-sizes one DRR
+    rotation's deficit credit must be granted the moment the pipe goes
+    idle — with nothing in flight there is no future release() to run
+    another grant pass, so deficit arithmetic alone would park it
+    until the shed deadline (degrade-never-hang)."""
+    from chunky_bits_tpu.cluster.qos import QUANTUM
+
+    async def main():
+        config = QosConfig.from_obj({})
+        sched = QosScheduler(config, read_capacity=1,
+                             write_capacity=1, queue_timeout_s=30.0)
+        await sched.acquire("read", OTHER)
+        # one rotation credits weight x QUANTUM; this cost needs ten
+        waiter = asyncio.ensure_future(
+            sched.acquire("read", OTHER, cost=10 * QUANTUM))
+        await asyncio.sleep(0)
+        sched.release("read")  # pipe now idle, waiter still queued
+        await asyncio.wait_for(waiter, timeout=1.0)
+        stats = sched.stats().to_obj()["tenants"][OTHER]
+        assert stats["admitted"] == 2
+        assert stats["shed"] == 0
+        sched.release("read")
+
+    asyncio.run(main())
+
+
+def test_rate_bucket_throttles_in_virtual_time():
+    """A tenant's byte-rate bucket bounds sustained throughput; the
+    clock seam makes the wait virtual under the sim loop (the same
+    machinery the noisy_neighbor scenario runs)."""
+    from chunky_bits_tpu.sim import run as sim_run
+    from chunky_bits_tpu.utils import clock as clock_mod
+
+    async def main():
+        config = QosConfig.from_obj({"tenants": {
+            "bulk": {"rate_bytes_per_sec": 1000.0, "keys": ["B"]}}})
+        sched = QosScheduler(config, read_capacity=64,
+                             write_capacity=8)
+        t0 = clock_mod.monotonic()
+        # burst allowance covers the first second's worth; the rest
+        # must wait for accrual: 5000 bytes at 1000 B/s >= 4 virtual s
+        for _ in range(5):
+            await sched.acquire("read", "bulk", cost=1000)
+            sched.release("read")
+        elapsed = clock_mod.monotonic() - t0
+        row = sched.stats().to_obj()["tenants"]["bulk"]
+        return elapsed, row
+
+    elapsed, row = sim_run(main())
+    assert elapsed >= 3.5, elapsed
+    assert row["throttle_waits"] >= 3
+    assert row["admitted"] == 5
+
+
+def test_pressure_and_hedge_advisor():
+    async def main():
+        config = QosConfig.from_obj({})
+        sched = QosScheduler(config, read_capacity=4,
+                             write_capacity=2,
+                             read_p99_objective_ms=100.0)
+        assert sched.pressure() == 0.0
+        assert sched.allow_hedge() is True  # no signal -> allow
+        # saturate half the read capacity: pressure suppresses
+        await sched.acquire("read", OTHER)
+        await sched.acquire("read", OTHER)
+        assert sched.pressure() == 0.5
+        assert sched.allow_hedge() is False
+        sched.release("read")
+        sched.release("read")
+        # ample p99 headroom (observed ~10ms vs 100ms objective):
+        # conserve the budget
+        for _ in range(32):
+            sched.note_request("read", 0.010)
+        assert sched.allow_hedge() is False
+        # tail near the objective: spend the budget
+        for _ in range(32):
+            sched.note_request("read", 0.095)
+        assert sched.allow_hedge() is True
+        stats = sched.stats()
+        assert stats.hedge_suppressed == 1
+        assert stats.hedge_conserved == 1
+
+    asyncio.run(main())
+
+
+# ---- downstream hooks ----
+
+def test_hedge_gate_denial_consumes_no_budget_token():
+    board = HealthScoreboard(hedge_ms=10.0)
+    # top the budget off (starts at the burst; primaries accrue it)
+    for _ in range(100):
+        board.note_primary()
+    allowed_before = board.try_fire_hedge()
+    assert allowed_before is True
+    fired_before = board.stats().hedges_fired
+    board.set_hedge_gate(lambda: False)
+    assert board.hedge_allowed() is False
+    for _ in range(10):
+        assert board.try_fire_hedge() is False
+    # gate-denied launches burned nothing: removing the gate fires
+    # immediately from the same balance
+    board.set_hedge_gate(None)
+    assert board.hedge_allowed() is True
+    assert board.try_fire_hedge() is True
+    assert board.stats().hedges_fired == fired_before + 1
+
+
+def test_token_bucket_pressure_scales_accrual():
+    from chunky_bits_tpu.sim import run as sim_run
+    from chunky_bits_tpu.utils import clock as clock_mod
+
+    async def take_seconds(pressure_fn) -> float:
+        bucket = TokenBucket(1000.0)
+        if pressure_fn is not None:
+            bucket.set_pressure(pressure_fn)
+        await bucket.take(1000.0)  # burst allowance
+        t0 = clock_mod.monotonic()
+        await bucket.take(1000.0)  # must accrue
+        return clock_mod.monotonic() - t0
+
+    free = sim_run(take_seconds(None))
+    half = sim_run(take_seconds(lambda: 0.5))
+    full = sim_run(take_seconds(lambda: 1.0))
+    assert 0.9 <= free <= 1.5, free
+    # accrual scaled by (1 - pressure): twice as slow at 0.5
+    assert 1.8 <= half <= 2.6, half
+    # degrade, never hang: full pressure floors at MIN_ACCRUAL (5%),
+    # it never stops accruing
+    assert 18.0 <= full <= 25.0, full
+
+
+# ---- tunables ----
+
+def test_tunables_qos_mapping_round_trip_and_validation():
+    obj = {"qos": {"enabled": True,
+                   "tenants": {"gold": {"weight": 2}}}}
+    t = tunables_mod.Tunables.from_obj(obj)
+    assert t.qos["enabled"] is True
+    assert t.to_obj()["qos"] == obj["qos"]
+    # absent stays absent (and off by default)
+    t2 = tunables_mod.Tunables.from_obj({})
+    assert t2.qos == {}
+    assert "qos" not in t2.to_obj()
+    with pytest.raises(SerdeError, match="invalid qos mapping"):
+        tunables_mod.Tunables.from_obj(
+            {"qos": {"tenants": {"a": {"nope": 1}}}})
+
+
+def test_qos_enabled_env_accessor(monkeypatch):
+    monkeypatch.delenv(tunables_mod.QOS_ENV, raising=False)
+    assert tunables_mod.qos_enabled() is False
+    monkeypatch.setenv(tunables_mod.QOS_ENV, "1")
+    assert tunables_mod.qos_enabled() is True
+    monkeypatch.setenv(tunables_mod.QOS_ENV, "0")
+    assert tunables_mod.qos_enabled() is False
+
+
+# ---- the gateway ----
+
+def _make_cluster(tmp_path, qos: dict):
+    from chunky_bits_tpu.cluster import Cluster
+
+    dirs = []
+    for i in range(5):
+        d = tmp_path / f"disk{i}"
+        d.mkdir(exist_ok=True)
+        dirs.append(str(d))
+    meta = tmp_path / "meta"
+    meta.mkdir(exist_ok=True)
+    return Cluster.from_obj({
+        "destinations": [{"location": d} for d in dirs],
+        "metadata": {"type": "path", "format": "yaml",
+                     "path": str(meta)},
+        "profiles": {"default": {"data": 3, "parity": 2,
+                                 "chunk_size": 12}},
+        "tunables": {**({"qos": qos} if qos else {})},
+    })
+
+
+QOS_YAML = {
+    "enabled": True,
+    "tenants": {
+        "gold": {"weight": 4, "keys": ["k-gold"]},
+        "bulk": {"prefixes": ["/bulk/"]},
+    },
+}
+
+
+def test_gateway_tenant_resolution_log_split_and_metrics(tmp_path):
+    """End to end through a real app: tenants resolve from key/prefix
+    into the access log, request_stats split per tenant, the /stats
+    qos stanza, and the per-tenant cb_qos_* families on /metrics."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from chunky_bits_tpu.file.profiler import (Profiler,
+                                               tenant_request_stats)
+    from chunky_bits_tpu.gateway import make_app
+
+    payload = os.urandom(30000)
+    profiler = Profiler()
+
+    async def main():
+        cluster = _make_cluster(tmp_path, QOS_YAML)
+        app = make_app(cluster, profiler=profiler)
+        async with TestClient(TestServer(app)) as client:
+            r = await client.put("/bulk/obj", data=payload,
+                                 headers={"X-Api-Key": "k-gold"})
+            assert r.status == 200
+            # key beats prefix: the PUT above was gold's
+            for _ in range(2):
+                r = await client.get(
+                    "/bulk/obj", headers={"X-Api-Key": "k-gold"})
+                assert await r.read() == payload
+            r = await client.get("/bulk/obj")  # prefix -> bulk
+            assert await r.read() == payload
+            r = await client.get("/bulk/obj",
+                                 headers={"X-Api-Key": "k-stale"})
+            assert await r.read() == payload  # unknown key -> prefix
+            stats = await (await client.get("/stats")).json()
+            metrics = await (await client.get("/metrics")).text()
+            return stats, metrics
+
+    stats, metrics = asyncio.run(main())
+
+    # /stats per-tenant split: same records, same percentile code
+    by_tenant = stats["requests_by_tenant"]
+    assert by_tenant["gold"]["count"] == 3  # 1 PUT + 2 GETs
+    assert by_tenant["bulk"]["count"] == 2
+    # the access-log entries themselves carry their tenant, and
+    # tenant_request_stats slices them the same way
+    split = tenant_request_stats(profiler.peek_requests())
+    assert split["gold"].count == 3
+    assert split["bulk"].count == 2
+    assert OTHER in split  # the /stats+/metrics scrapes themselves
+    # /stats and /metrics read the same scheduler
+    qos = stats["qos"]
+    assert qos["enabled"] is True
+    assert set(qos["tenants"]) == {"gold", "bulk", OTHER}
+    assert qos["tenants"]["gold"]["admitted"] == 3
+    assert qos["tenants"]["bulk"]["admitted"] == 2
+    assert 'cb_qos_admitted_total{tenant="gold"} 3' in metrics
+    assert 'cb_qos_admitted_total{tenant="bulk"} 2' in metrics
+    assert "cb_qos_pressure" in metrics
+    assert 'qos="on"' in metrics
+
+
+def test_gateway_qos_off_is_zero_overhead(tmp_path):
+    """Default-off: no qos modules imported by a plain gateway, no
+    qos label sets minted, /stats says enabled:false — checked in a
+    clean interpreter so this suite's own qos imports cannot pollute
+    the verdict."""
+    import subprocess
+
+    code = """
+import asyncio, os, sys
+from aiohttp.test_utils import TestClient, TestServer
+from chunky_bits_tpu.cluster import Cluster
+from chunky_bits_tpu.gateway import make_app
+
+root = sys.argv[1]
+dirs = []
+for i in range(5):
+    d = os.path.join(root, f"disk{i}")
+    os.makedirs(d); dirs.append(d)
+meta = os.path.join(root, "meta"); os.makedirs(meta)
+cluster = Cluster.from_obj({
+    "destinations": [{"location": d} for d in dirs],
+    "metadata": {"type": "path", "format": "yaml", "path": meta},
+    "profiles": {"default": {"data": 3, "parity": 2,
+                             "chunk_size": 12}},
+})
+
+async def main():
+    app = make_app(cluster)
+    async with TestClient(TestServer(app)) as client:
+        assert (await client.put("/x", data=b"hello")).status == 200
+        r = await client.get("/x")
+        assert await r.read() == b"hello"
+        stats = await (await client.get("/stats")).json()
+        metrics = await (await client.get("/metrics")).text()
+    assert stats["qos"] == {"enabled": False}
+    assert "requests_by_tenant" not in stats
+    assert "cb_qos_" not in metrics
+    assert 'qos="off"' in metrics
+
+asyncio.run(main())
+assert "chunky_bits_tpu.cluster.qos" not in sys.modules, "qos imported"
+assert "chunky_bits_tpu.gateway.qos" not in sys.modules, "qos imported"
+print("OK")
+"""
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop(tunables_mod.QOS_ENV, None)
+    r = subprocess.run(
+        [sys.executable, "-c", code, str(tmp_path)],
+        capture_output=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    assert b"OK" in r.stdout
+
+
+def test_gateway_shed_has_derived_retry_after(tmp_path):
+    """A shed GET's Retry-After is a positive integer (derived from
+    the observed completion rate once traffic exists; the 1 s
+    fallback on a cold worker)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from chunky_bits_tpu.gateway import make_app
+
+    payload = os.urandom(30000)
+
+    async def main():
+        # pin QoS OFF in YAML (wins over the env flag, so the QOS=1
+        # tier-1 leg still exercises the shed path this test covers)
+        cluster = _make_cluster(tmp_path, {"enabled": False})
+        app = make_app(cluster, max_concurrent_gets=1)
+        async with TestClient(TestServer(app)) as client:
+            assert (await client.put("/obj",
+                                     data=payload)).status == 200
+            # warm completions so the derivation has a rate window
+            for _ in range(3):
+                r = await client.get("/obj")
+                await r.read()
+            # saturate the single slot, then observe the shed
+            statuses = []
+            retry_after = []
+
+            async def one():
+                r = await client.get("/obj")
+                statuses.append(r.status)
+                if r.status == 503:
+                    retry_after.append(r.headers["Retry-After"])
+                await r.read()
+
+            await asyncio.gather(*[one() for _ in range(8)])
+            return statuses, retry_after
+
+    statuses, retry_after = asyncio.run(main())
+    assert 503 in statuses and 200 in statuses
+    for value in retry_after:
+        assert value.isdigit() and int(value) >= 1
+
+
+def test_gateway_qos_write_shed_and_tenant_queueing(tmp_path):
+    """With QoS on and a saturated read plane, a flood tenant's
+    excess queues (bounded) while another tenant still gets served —
+    the gateway-level DRR sanity check (the full isolation claim is
+    sim scenario noisy_neighbor + bench --config 19)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from chunky_bits_tpu.gateway import make_app
+
+    payload = os.urandom(30000)
+
+    async def main():
+        cluster = _make_cluster(tmp_path, QOS_YAML)
+        app = make_app(cluster, max_concurrent_gets=2)
+        async with TestClient(TestServer(app)) as client:
+            assert (await client.put(
+                "/bulk/obj", data=payload,
+                headers={"X-Api-Key": "k-gold"})).status == 200
+
+            async def read(key: str) -> int:
+                r = await client.get(
+                    "/bulk/obj",
+                    headers={"X-Api-Key": key} if key else {})
+                await r.read()
+                return r.status
+
+            # a burst beyond capacity: with QoS on nothing sheds (the
+            # scheduler queues within its bounds) and every tenant's
+            # reads land
+            statuses = await asyncio.gather(
+                *[read("k-gold") for _ in range(6)],
+                *[read("") for _ in range(6)])
+            assert statuses == [200] * 12
+            stats = await (await client.get("/stats")).json()
+            return stats
+
+    stats = asyncio.run(main())
+    tenants = stats["qos"]["tenants"]
+    assert tenants["gold"]["admitted"] >= 6
+    assert tenants["bulk"]["admitted"] >= 6
+    assert tenants["gold"]["shed"] == 0
+    assert tenants["bulk"]["shed"] == 0

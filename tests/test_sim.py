@@ -323,6 +323,13 @@ _KEY_VERDICTS = {
                       "slo_no_false_positives"),
     "slow_leak": ("converged", "corruption_detected",
                   "slo_no_false_positives"),
+    # weighted-fair admission isolates the victim tenant from the
+    # flood (and the FIFO control leg must actually have degraded it,
+    # else both isolation verdicts pass trivially)
+    "noisy_neighbor": ("victim_isolated_under_drr",
+                       "victim_near_baseline_under_drr",
+                       "fifo_leg_degraded",
+                       "slo_no_false_positives"),
     # the disk-fault axis (ISSUE 14): a corruption burst plus silent
     # torn and ENOSPC-refused repair writes — all absorbed by
     # scrub/repair, never client-visible, the engine stays silent
